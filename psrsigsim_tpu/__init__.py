@@ -1,0 +1,14 @@
+"""psrsigsim_tpu — a TPU-native pulsar signal simulation framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of PsrSigSim (the NANOGrav
+Pulsar Signal Simulator): pulse synthesis, interstellar-medium propagation,
+telescope/receiver effects, and PSRFITS/pdv data products — designed as pure
+functional pipelines over signal pytrees that jit-compile to single XLA
+programs, vmap over Monte-Carlo ensembles, and shard across TPU meshes.
+"""
+
+__version__ = "0.1.0"
+
+from . import utils  # noqa: F401
+
+__all__ = ["utils", "__version__"]

@@ -1,0 +1,41 @@
+"""Shared utilities: units at the config boundary, constants, PRNG plumbing,
+and host-side numerics (reference layer: psrsigsim/utils/)."""
+
+from .constants import DM_K, DM_K_MS_MHZ2, KB_JY_M2_PER_K, KOLMOGOROV_BETA
+from .quantity import Quantity, Unit, UnitConversionError, make_quant
+from .rng import KeySequence, next_key, set_seed, stage_key
+from .utils import (
+    acf2d,
+    down_sample,
+    find_nearest,
+    make_par,
+    rebin,
+    savitzky_golay,
+    shift_t,
+    text_search,
+    top_hat_width,
+)
+
+__all__ = [
+    "make_quant",
+    "Quantity",
+    "Unit",
+    "UnitConversionError",
+    "DM_K",
+    "DM_K_MS_MHZ2",
+    "KOLMOGOROV_BETA",
+    "KB_JY_M2_PER_K",
+    "stage_key",
+    "KeySequence",
+    "set_seed",
+    "next_key",
+    "shift_t",
+    "down_sample",
+    "rebin",
+    "top_hat_width",
+    "savitzky_golay",
+    "find_nearest",
+    "acf2d",
+    "text_search",
+    "make_par",
+]

@@ -1,0 +1,82 @@
+"""Explicit PRNG key plumbing.
+
+The reference draws from scipy/numpy global RNG state and tells users to call
+``numpy.random.seed`` for reproducibility (reference docs/tutorial_1.rst).
+On TPU we thread explicit ``jax.random`` keys instead, so ensembles are
+reproducible and *sharding-invariant*: every (observation, stage) pair derives
+its own key from a root seed, independent of which device computes it.
+
+Two layers:
+
+* :func:`stage_key` — pure functional derivation used inside jitted pipelines.
+* :class:`KeySequence` — a stateful convenience wrapper used by the
+  object-oriented API layer (``Pulsar.make_pulses`` etc.) so casual users get
+  fresh randomness per call, exactly like the reference's global-state flow,
+  but still seedable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["stage_key", "KeySequence", "set_seed", "next_key", "default_keys"]
+
+# Stable stage identifiers: fold into the key so each pipeline stage draws an
+# independent stream regardless of call order.
+STAGES = {
+    "pulse": 0,
+    "noise": 1,
+    "null_select": 2,
+    "null_noise": 3,
+    "scint": 4,
+    "user": 5,
+}
+
+
+def stage_key(root, stage, index=0):
+    """Derive the key for (stage, index) from a root key.
+
+    ``index`` is typically the observation/epoch number in an ensemble; using
+    ``fold_in`` keeps the stream independent of mesh layout and batch order.
+    """
+    sid = STAGES[stage] if isinstance(stage, str) else int(stage)
+    return jax.random.fold_in(jax.random.fold_in(root, sid), index)
+
+
+class KeySequence:
+    """Stateful key dispenser for the OO API layer (host side only).
+
+    Key creation is lazy so that importing the package never touches a JAX
+    backend — device initialization happens on first draw.
+    """
+
+    def __init__(self, seed=0):
+        self._seed = seed
+        self._key = None
+
+    def seed(self, seed):
+        self._seed = seed
+        self._key = None
+
+    def next(self, stage="user", index=0):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        self._key, sub = jax.random.split(self._key)
+        return stage_key(sub, stage, index)
+
+
+default_keys = KeySequence(0)
+
+
+def set_seed(seed):
+    """Seed the global key sequence used by the OO API layer.
+
+    Equivalent role to ``numpy.random.seed`` in the reference's workflow.
+    """
+    default_keys.seed(seed)
+
+
+def next_key(stage="user", index=0):
+    """Draw the next key from the global sequence."""
+    return default_keys.next(stage, index)

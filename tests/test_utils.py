@@ -1,0 +1,220 @@
+"""Unit tests for psrsigsim_tpu.utils (quantity layer + host numerics)."""
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.utils import (
+    DM_K,
+    DM_K_MS_MHZ2,
+    KOLMOGOROV_BETA,
+    Quantity,
+    UnitConversionError,
+    acf2d,
+    down_sample,
+    find_nearest,
+    make_quant,
+    rebin,
+    savitzky_golay,
+    shift_t,
+    text_search,
+    top_hat_width,
+)
+
+
+class TestQuantity:
+    def test_make_quant_attaches_unit(self):
+        q = make_quant(1400.0, "MHz")
+        assert q.value == 1400.0
+        assert q.unit.name == "MHz"
+
+    def test_make_quant_passthrough(self):
+        q = make_quant(make_quant(1.4, "GHz"), "MHz")
+        assert q.value == 1.4
+        assert q.unit.name == "GHz"
+
+    def test_make_quant_incompatible_raises(self):
+        with pytest.raises(ValueError):
+            make_quant(make_quant(1.0, "s"), "MHz")
+
+    def test_to_conversion(self):
+        assert make_quant(1.4, "GHz").to("MHz").value == pytest.approx(1400.0)
+        assert make_quant(20.48, "us").to("ms").value == pytest.approx(0.02048)
+
+    def test_reciprocal_sample_rate(self):
+        # the FilterBankSignal default rate: (1/20.48us).to('MHz')
+        samprate = (1 / make_quant(20.48, "us")).to("MHz")
+        assert samprate.value == pytest.approx(1.0 / 20.48)
+
+    def test_decompose_samprate_times_period(self):
+        # Nph = int((samprate * period).decompose()): MHz * s -> 1e6
+        samprate = make_quant(1.0, "MHz")
+        period = make_quant(0.005, "s")
+        nph = int((samprate * period).decompose())
+        assert nph == 5000
+
+    def test_dispersion_delay_units(self):
+        # DM_K * DM / f^2 -> ms, the disperse() delay formula
+        dm = make_quant(10.0, "pc/cm^3")
+        freqs = make_quant(np.array([400.0, 800.0, 1600.0]), "MHz")
+        delays = (DM_K * dm * np.power(freqs, -2)).to("ms")
+        expect = DM_K_MS_MHZ2 * 10.0 / np.array([400.0, 800.0, 1600.0]) ** 2
+        np.testing.assert_allclose(delays.value, expect)
+
+    def test_compound_unit_gain(self):
+        kB = make_quant(1.38064852e3, "Jy*m^2/K")
+        gain = make_quant(5500.0, "m^2") / (2 * kB)
+        assert gain.to("K/Jy").value == pytest.approx(
+            5500.0 / (2 * 1.38064852e3)
+        )
+
+    def test_dimensionless_float_and_sqrt(self):
+        tsys = make_quant(35.0, "K")
+        gain = make_quant(2.0, "K/Jy")
+        dt = make_quant(1.0, "s")
+        bw = make_quant(1.5625, "MHz")
+        sig = tsys / gain / np.sqrt(2 * dt * bw)
+        assert sig.to("Jy").value == pytest.approx(
+            35.0 / 2.0 / np.sqrt(2 * 1.5625e6)
+        )
+
+    def test_add_sub_mixed_units(self):
+        total = make_quant(1.0, "ms") + make_quant(500.0, "us")
+        assert total.value == pytest.approx(1.5)
+        assert total.unit.name == "ms"
+
+    def test_comparisons(self):
+        assert make_quant(1.0, "GHz") > make_quant(900.0, "MHz")
+        assert make_quant(1.0, "ms") <= make_quant(0.001, "s")
+
+    def test_float_of_dimensioned_raises(self):
+        with pytest.raises(UnitConversionError):
+            float(make_quant(1.0, "s"))
+
+    def test_array_quantity_indexing_and_iter(self):
+        q = make_quant(np.arange(4.0), "MHz")
+        assert q[2].value == 2.0
+        assert len(q) == 4
+        assert [x.value for x in q] == [0.0, 1.0, 2.0, 3.0]
+
+    def test_fd_param_log_power(self):
+        # FD_shift arithmetic: c_i * ln(f/1GHz)^i
+        freqs = make_quant(np.array([500.0, 2000.0]), "MHz")
+        ref = make_quant(1000.0, "MHz")
+        logs = np.log(freqs / ref)
+        np.testing.assert_allclose(logs, np.log(np.array([0.5, 2.0])))
+
+
+class TestHostNumerics:
+    def test_shift_t_integer_roll(self):
+        y = np.arange(10.0)
+        np.testing.assert_array_equal(shift_t(y, 3), np.roll(y, 3))
+
+    def test_shift_t_fourier_matches_roll_for_whole_samples(self):
+        rng = np.random.default_rng(0)
+        y = rng.standard_normal(64)
+        shifted = shift_t(y, 5.0, dt=1.0)  # float shift -> FFT path
+        np.testing.assert_allclose(shifted, np.roll(y, 5), atol=1e-10)
+
+    def test_shift_t_physical_units(self):
+        y = np.sin(2 * np.pi * np.arange(128) / 16)
+        out = shift_t(y, 0.5, dt=0.125)  # 4-sample delay
+        np.testing.assert_allclose(out, np.roll(y, 4), atol=1e-9)
+
+    def test_down_sample(self):
+        ar = np.arange(12.0)
+        np.testing.assert_allclose(
+            down_sample(ar, 4), [1.5, 5.5, 9.5]
+        )
+
+    def test_rebin_matches_down_sample_for_integer_factor(self):
+        ar = np.arange(16.0)
+        np.testing.assert_allclose(rebin(ar, 4), down_sample(ar, 4))
+
+    def test_rebin_non_integer(self):
+        ar = np.arange(10.0)
+        out = rebin(ar, 3)
+        assert out.shape == (3,)
+        assert np.isfinite(out).all()
+
+    def test_top_hat_width_value(self):
+        # numeric golden: 2 * 4.148808e3 * DM * df / f0^3 * 1e3
+        w = top_hat_width(1.5625, 1400.0, 10.0)
+        assert w == pytest.approx(
+            2 * 4.148808e3 * 10.0 * 1.5625 / 1400.0**3 * 1e3
+        )
+
+    def test_savitzky_golay_smooths(self):
+        t = np.linspace(-4, 4, 500)
+        rng = np.random.default_rng(1)
+        clean = np.exp(-(t**2))
+        noisy = clean + rng.normal(0, 0.05, t.shape)
+        smooth = savitzky_golay(noisy, 31, 4)
+        assert np.mean((smooth - clean) ** 2) < np.mean((noisy - clean) ** 2)
+
+    def test_savitzky_golay_errors(self):
+        with pytest.raises(TypeError):
+            savitzky_golay(np.arange(10.0), 4, 2)  # even window
+        with pytest.raises(TypeError):
+            savitzky_golay(np.arange(10.0), 3, 4)  # window too small
+
+    def test_find_nearest(self):
+        arr = np.array([10.0, 8.0, 6.0, 4.0])
+        assert find_nearest(arr, 6.5) == 2
+
+    def test_acf2d_fast_vs_slow(self):
+        rng = np.random.default_rng(2)
+        arr = rng.standard_normal((8, 16))
+        np.testing.assert_allclose(
+            acf2d(arr, speed="fast"), acf2d(arr, speed="slow"), atol=1e-8
+        )
+
+    def test_acf2d_peak_at_zero_lag(self):
+        rng = np.random.default_rng(3)
+        arr = rng.standard_normal((6, 10))
+        acf = acf2d(arr, speed="fast")
+        # zero-lag (center of 'full' output) equals the mean square
+        assert acf[5, 9] == pytest.approx(np.mean(arr**2))
+
+    def test_text_search(self, tmp_path):
+        p = tmp_path / "table.txt"
+        p.write_text(
+            "NAME FREQ FLUX\nJ0000+0000 1400 1.5\nJ1713+0747 1400 8.2\n"
+        )
+        vals = text_search(["J1713+0747"], ["FLUX"], str(p))
+        assert vals == (8.2,)
+        with pytest.raises(ValueError):
+            text_search(["NOPE"], ["FLUX"], str(p))
+        with pytest.raises(ValueError):
+            text_search(["1400"], ["FLUX"], str(p))
+
+    def test_kolmogorov_beta(self):
+        assert KOLMOGOROV_BETA == pytest.approx(11.0 / 3.0)
+
+
+class TestReviewRegressions:
+    """Regression tests for review findings on the quantity/utils layer."""
+
+    def test_double_star_power_parsing(self):
+        q = make_quant(5500.0, "Jy*m**2/K")
+        assert q.to("Jy*m^2/K").value == pytest.approx(5500.0)
+
+    def test_quantity_rewrap_converts(self):
+        q = Quantity(make_quant(1.0, "s"), "ms")
+        assert q.value == pytest.approx(1000.0)
+        assert q.unit.name == "ms"
+
+    def test_unit_times_quantity_is_product(self):
+        from psrsigsim_tpu.utils.quantity import Unit
+
+        q = Unit("ms") * make_quant(2.0, "s")
+        assert q.unit.dims == (2, 0, 0, 0, 0)  # time^2
+
+    def test_shift_t_odd_length_preserves_shape(self):
+        y = np.arange(9.0)
+        assert shift_t(y, 0.5).shape == (9,)
+
+    def test_hash_consistent_with_eq(self):
+        a = make_quant(1.0, "ms")
+        b = make_quant(0.001, "s")
+        assert a == b
+        assert hash(a) == hash(b)
