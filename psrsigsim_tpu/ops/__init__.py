@@ -1,0 +1,43 @@
+"""Batched TPU kernels: the device-side numerics of the framework.
+
+Every op takes plain arrays + static Python config, is pure, and composes
+under jit/vmap/shard_map.  Signal semantics (delay bookkeeping, guards,
+units) live in the model layer above.
+"""
+
+from .convolve import convolve_profiles, fft_convolve_full
+from .interp import PchipCoeffs, pchip_eval, pchip_fit, pchip_slopes
+from .resample import block_downsample, rebin
+from .shift import (
+    coherent_dedisperse,
+    coherent_dedispersion_transfer,
+    fourier_shift,
+)
+from .stats import chi2_draw_norm, chi2_sample, normal_sample
+from .window import (
+    fold_periods,
+    offpulse_window,
+    offpulse_window_indices,
+    offpulse_window_jax,
+)
+
+__all__ = [
+    "fourier_shift",
+    "coherent_dedisperse",
+    "coherent_dedispersion_transfer",
+    "pchip_fit",
+    "pchip_eval",
+    "pchip_slopes",
+    "PchipCoeffs",
+    "chi2_sample",
+    "normal_sample",
+    "chi2_draw_norm",
+    "block_downsample",
+    "rebin",
+    "fft_convolve_full",
+    "convolve_profiles",
+    "fold_periods",
+    "offpulse_window",
+    "offpulse_window_jax",
+    "offpulse_window_indices",
+]

@@ -1,0 +1,47 @@
+"""Batched FFT convolution of pulse profiles with kernel arrays.
+
+The reference convolves exponential scattering tails into profiles one
+channel at a time through ``scipy.signal.convolve(..., method='fft')``
+(psrsigsim/ism/ism.py:243-288).  Here all channels convolve in one zero-padded
+batched rFFT product.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fft_convolve_full", "convolve_profiles"]
+
+
+def fft_convolve_full(a, b):
+    """'full'-mode linear convolution along the last axis via zero-padded FFT.
+
+    ``a``/``b``: ``(..., N)`` and ``(..., M)`` with broadcastable leading
+    axes.  Returns ``(..., N+M-1)``.
+    """
+    n = a.shape[-1]
+    m = b.shape[-1]
+    nfft = n + m - 1
+    fa = jnp.fft.rfft(a, n=nfft, axis=-1)
+    fb = jnp.fft.rfft(b, n=nfft, axis=-1)
+    return jnp.fft.irfft(fa * fb, n=nfft, axis=-1)
+
+
+def convolve_profiles(profiles, kernels, width):
+    """Convolve per-channel kernels into profiles, preserving profile flux.
+
+    Reference semantics (ism/ism.py:265-288): normalize both operands to unit
+    sum (guarding zero-sum rows), 'full' FFT convolution, truncate to
+    ``width`` bins, rescale by the original profile sum.
+
+    Args:
+        profiles: ``(Nchan, Nph)``.
+        kernels: ``(Nchan, M)`` (typically M == Nph exponential tails).
+        width: output bins (static int), normally Nph.
+    """
+    psum = profiles.sum(axis=-1, keepdims=True)
+    ksum = kernels.sum(axis=-1, keepdims=True)
+    pnorm = jnp.where(psum != 0.0, profiles / jnp.where(psum == 0.0, 1.0, psum), profiles)
+    knorm = jnp.where(ksum != 0.0, kernels / jnp.where(ksum == 0.0, 1.0, ksum), kernels)
+    conv = fft_convolve_full(pnorm, knorm)[..., :width]
+    return psum * conv

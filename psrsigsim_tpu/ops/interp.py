@@ -1,0 +1,129 @@
+"""PCHIP (monotone piecewise-cubic Hermite) interpolation in pure JAX.
+
+The reference builds data portraits through ``scipy.interpolate.
+PchipInterpolator(phases, profiles, axis=1)`` (psrsigsim/pulsar/
+portraits.py:252) and evaluates it at every sample phase — single-pulse mode
+evaluates at ``nsamp`` phases per channel, a serial scipy hot loop
+(psrsigsim/pulsar/pulsar.py:241-244).  Here the Fritsch–Carlson slope
+construction is vectorized over channels and evaluation is a gather plus a
+cubic Hermite polynomial — jit/vmap-able, and the gather+FMA pattern XLA
+lowers well on TPU.
+
+Slope formulas match scipy's ``_find_derivatives`` (weighted harmonic mean in
+the interior, Fritsch–Butland one-sided edges with monotonicity clamps), so
+profiles agree with the reference to float tolerance.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["pchip_slopes", "pchip_eval", "PchipCoeffs", "pchip_fit"]
+
+from typing import NamedTuple
+
+
+class PchipCoeffs(NamedTuple):
+    """Interpolant state: breakpoints ``x (N,)``, values ``y (..., N)``,
+    endpoint slopes ``d (..., N)``."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    d: jnp.ndarray
+
+
+def pchip_slopes(x, y):
+    """Fritsch–Carlson derivative estimates for shape-preserving cubics.
+
+    Args:
+        x: breakpoints ``(N,)``, strictly increasing, N >= 2.
+        y: values ``(..., N)`` (batched over leading axes, e.g. channels).
+
+    Returns:
+        slopes ``(..., N)``.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    h = jnp.diff(x)  # (N-1,)
+    delta = jnp.diff(y, axis=-1) / h  # (..., N-1)
+
+    if x.shape[-1] == 2:
+        return jnp.broadcast_to(delta, y.shape[:-1] + (1,)).repeat(2, axis=-1)
+
+    hk = h[1:]  # h_k      (N-2,)
+    hkm1 = h[:-1]  # h_{k-1}
+    dk = delta[..., 1:]  # Δ_k     (..., N-2)
+    dkm1 = delta[..., :-1]  # Δ_{k-1}
+
+    w1 = 2 * hk + hkm1
+    w2 = hk + 2 * hkm1
+    # weighted harmonic mean; zero when slopes differ in sign or either is 0
+    smooth = jnp.sign(dkm1) * jnp.sign(dk) > 0
+    whmean = jnp.where(
+        smooth,
+        (w1 + w2) / jnp.where(smooth, w1 / jnp.where(dkm1 == 0, 1, dkm1)
+                              + w2 / jnp.where(dk == 0, 1, dk), 1.0),
+        0.0,
+    )
+
+    d_start = _edge_slope(h[0], h[1], delta[..., 0], delta[..., 1])
+    d_end = _edge_slope(h[-1], h[-2], delta[..., -1], delta[..., -2])
+    return jnp.concatenate(
+        [d_start[..., None], whmean, d_end[..., None]], axis=-1
+    )
+
+
+def _edge_slope(h0, h1, d0, d1):
+    """Three-point one-sided slope with scipy's monotonicity clamps
+    (scipy PchipInterpolator._edge_case)."""
+    d = ((2 * h0 + h1) * d0 - h0 * d1) / (h0 + h1)
+    d = jnp.where(jnp.sign(d) != jnp.sign(d0), 0.0, d)
+    d = jnp.where(
+        (jnp.sign(d0) != jnp.sign(d1)) & (jnp.abs(d) > 3 * jnp.abs(d0)),
+        3 * d0,
+        d,
+    )
+    return d
+
+
+def pchip_fit(x, y):
+    """Construct a PCHIP interpolant over the last axis of ``y``."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    return PchipCoeffs(x=x, y=y, d=pchip_slopes(x, y))
+
+
+def pchip_eval(coeffs, xq):
+    """Evaluate a PCHIP interpolant at query points.
+
+    Args:
+        coeffs: :class:`PchipCoeffs` with ``y``/``d`` shaped ``(..., N)``.
+        xq: query points ``(M,)`` (or any shape; flattened semantics apply
+            along the last axis).
+
+    Returns:
+        values ``(..., M)``.  Queries outside ``[x[0], x[-1]]`` extrapolate
+        with the terminal cubic, matching scipy's default.
+    """
+    x, y, d = coeffs
+    xq = jnp.asarray(xq)
+    n = x.shape[0]
+    idx = jnp.clip(jnp.searchsorted(x, xq, side="right") - 1, 0, n - 2)
+
+    x0 = x[idx]
+    h = x[idx + 1] - x0
+    t = (xq - x0) / h  # (M,)
+
+    y0 = y[..., idx]
+    y1 = y[..., idx + 1]
+    d0 = d[..., idx]
+    d1 = d[..., idx + 1]
+
+    # cubic Hermite basis
+    t2 = t * t
+    t3 = t2 * t
+    h00 = 2 * t3 - 3 * t2 + 1
+    h10 = t3 - 2 * t2 + t
+    h01 = -2 * t3 + 3 * t2
+    h11 = t3 - t2
+    return y0 * h00 + d0 * (h * h10) + y1 * h01 + d1 * (h * h11)

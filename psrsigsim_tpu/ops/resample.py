@@ -1,0 +1,50 @@
+"""Batched downsampling / rebinning.
+
+The reference resamples one channel at a time (telescope/telescope.py:109,119
+looping utils.down_sample:62-68 and utils.rebin:71-91).  Both collapse to
+whole-array reshapes/gathers here, batched over every leading axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["block_downsample", "rebin"]
+
+
+def block_downsample(data, fact):
+    """Downsample the last axis by integer factor ``fact`` via block means
+    (batched twin of utils.down_sample)."""
+    *lead, n = data.shape
+    return data.reshape(*lead, n // fact, fact).mean(axis=-1)
+
+
+def rebin(data, newlen):
+    """General rebin of the last axis to ``newlen`` bins by variable-width
+    window means.
+
+    Matches the reference's NaN-padded rebinner (utils/utils.py:71-91)
+    numerically: window ``ii`` spans samples ``ceil(edge_ii) ..
+    ceil(edge_ii + stride)``.  Implemented as a static gather + masked mean so
+    it jits with fixed shapes.
+    """
+    *lead, size = data.shape
+    # host-side static window geometry
+    edges = np.linspace(0, size, newlen, endpoint=False)
+    stride = edges[1] - edges[0] if newlen > 1 else float(size)
+    width = int(math.ceil(stride))
+    starts = np.ceil(edges).astype(np.int64)  # (newlen,)
+    stops = np.minimum(np.ceil(edges + stride).astype(np.int64), size)
+
+    idx = starts[:, None] + np.arange(width)[None, :]  # (newlen, width)
+    valid = idx < stops[:, None]
+    idx = np.clip(idx, 0, size - 1)
+
+    gathered = data[..., jnp.asarray(idx)]  # (..., newlen, width)
+    mask = jnp.asarray(valid)
+    total = jnp.where(mask, gathered, 0.0).sum(axis=-1)
+    count = mask.sum(axis=-1)
+    return total / count

@@ -1,0 +1,47 @@
+"""Random draws for pulse and noise synthesis.
+
+The reference draws through scipy's global-state RNG —
+``stats.chi2(df).rvs(size=...)`` for intensity signals
+(psrsigsim/pulsar/pulsar.py:215-221,229-244; telescope/receiver.py:164-170)
+and ``stats.norm().rvs`` for amplitude signals (pulsar.py:166-183).  Here
+draws are explicit-key ``jax.random`` calls: chi-squared via the gamma
+sampler (χ²_k = 2·Gamma(k/2), valid for fractional k — the reference's
+``Nfold = sublen/period`` is routinely non-integer, pulsar.py:214), so a
+whole ``(Nchan, Nsamp)`` block is one fused device sample.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm"]
+
+
+def chi2_sample(key, df, shape, dtype=jnp.float32):
+    """Sample from a chi-squared distribution with (possibly fractional) df."""
+    return 2.0 * jax.random.gamma(key, jnp.asarray(df, dtype) / 2.0, shape, dtype)
+
+
+def normal_sample(key, shape, dtype=jnp.float32):
+    """Standard normal draws (amplitude-signal pulses and noise)."""
+    return jax.random.normal(key, shape, dtype)
+
+
+def chi2_draw_norm(dtype, df):
+    """Dynamic-range normalization for intensity draws (host-side, static).
+
+    float32 signals draw unnormalized with clip ceiling 200; int8 signals are
+    scaled so the 99.9th percentile of the χ²(df) distribution maps to
+    ``int8 max`` (reference: psrsigsim/signal/fb_signal.py:114-121).
+
+    Returns ``(draw_max, draw_norm)``.
+    """
+    import numpy as np
+    from scipy import stats as _sps
+
+    if dtype == np.int8 or dtype == jnp.int8:
+        limit = _sps.chi2.ppf(0.999, df)
+        draw_max = float(np.iinfo(np.int8).max)
+        return draw_max, draw_max / float(limit)
+    return 200.0, 1.0

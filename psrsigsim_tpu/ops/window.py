@@ -1,0 +1,94 @@
+"""Off-pulse window detection and phase folding.
+
+Off-pulse window: the minimum-integral sliding window over the peak profile,
+adapted by the reference from PyPulse (psrsigsim/pulsar/portraits.py:62-82).
+The reference loops every phase bin computing a trapezoid integral; here the
+windowed integrals are one circular gather + weighted sum.
+
+Folding: the reference's ``Backend.fold`` (telescope/backend.py:34-49)
+contains a reshape that only succeeds for one special observation length
+(it slices ``N_fold·Npbins`` columns but reshapes to ``N_fold·Npbins/2``
+elements per channel).  We implement the evidently *intended* operation —
+sum complete pulse periods into one folded profile — and document the
+divergence (see DIVERGENCES.md).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "offpulse_window",
+    "offpulse_window_jax",
+    "offpulse_window_indices",
+    "fold_periods",
+]
+
+
+def offpulse_window_indices(nphase):
+    """Static circular window offsets used by the off-pulse search.
+
+    windowsize = nphase/8 (may be fractional); offsets span
+    ``[-ws//2, +ws//2)`` exactly as the reference's
+    ``np.arange(i - ws//2, i + ws//2)`` (portraits.py:77).
+    """
+    ws = nphase / 8
+    half = int(ws // 2)
+    return jnp.arange(-half, half), half
+
+
+def offpulse_window(max_profile, nphase=None):
+    """Return the off-pulse window indices ``(2·(ws//2)+1,)`` of a profile.
+
+    Finds the circular window of width nphase/8 with minimal trapezoidal
+    integral; returns the bin indices of that window (reference:
+    portraits.py:62-82 — window centered on the minimum-integral position,
+    inclusive of both endpoints).
+
+    Host-side (numpy, float64): this runs once per configuration, and
+    float64 is needed for exact reference-parity tie-breaking — off-pulse
+    integrals underflow toward zero and float32 ties shift the argmin.
+    Use :func:`offpulse_window_jax` inside jitted pipelines.
+    """
+    prof = np.asarray(max_profile, dtype=np.float64)
+    n = prof.shape[-1] if nphase is None else nphase
+    ws = n / 8
+    half = int(ws // 2)
+    offsets = np.arange(-half, half)
+    win = (np.arange(n)[:, None] + offsets[None, :]) % n  # (n, 2*half)
+    vals = prof[win]
+    # np.trapezoid with unit spacing: sum minus half the endpoints
+    integral = vals.sum(axis=-1) - 0.5 * (vals[:, 0] + vals[:, -1])
+    minind = int(np.argmin(integral))
+    return (np.arange(-half, half + 1) + minind) % n
+
+
+def offpulse_window_jax(max_profile, nphase=None):
+    """Device/jit variant of :func:`offpulse_window` (float32 tie-breaking
+    may differ from the host version in fully flat off-pulse regions)."""
+    prof = jnp.asarray(max_profile)
+    n = prof.shape[-1] if nphase is None else nphase
+    offsets, half = offpulse_window_indices(n)
+    centers = jnp.arange(n)[:, None]
+    win = (centers + offsets[None, :]) % n  # (n, 2*half)
+    vals = prof[win]
+    integral = vals.sum(axis=-1) - 0.5 * (vals[:, 0] + vals[:, -1])
+    minind = jnp.argmin(integral)
+    return (jnp.arange(-half, half + 1) + minind) % n
+
+
+def fold_periods(data, nph):
+    """Fold a single-pulse time stream into one summed profile per channel.
+
+    Args:
+        data: ``(..., Nsamp)``.
+        nph: phase bins per period (static int).
+
+    Returns:
+        ``(..., nph)`` — the sum over all complete periods.
+    """
+    *lead, nsamp = data.shape
+    nfold = nsamp // nph
+    trimmed = data[..., : nfold * nph]
+    return trimmed.reshape(*lead, nfold, nph).sum(axis=-2)

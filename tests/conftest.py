@@ -3,7 +3,11 @@ initializes, so mesh/sharding tests run without TPU hardware."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The ambient environment pins JAX_PLATFORMS=axon (a tunnelled TPU), which is
+# wrong for unit tests, so default hard to cpu; set PSS_TEST_PLATFORM to run
+# the suite against real hardware.
+_platform = os.environ.get("PSS_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,4 +16,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# belt-and-braces: if a pytest plugin imported jax before this conftest, the
+# env var alone won't take effect
+jax.config.update("jax_platforms", _platform)
 jax.config.update("jax_enable_x64", False)

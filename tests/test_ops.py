@@ -1,0 +1,291 @@
+"""Parity and correctness tests for psrsigsim_tpu.ops against numpy/scipy."""
+
+import numpy as np
+import pytest
+import scipy.signal as spsig
+import scipy.stats as spstats
+from scipy.interpolate import PchipInterpolator
+
+from psrsigsim_tpu import ops
+from psrsigsim_tpu.utils import rebin as np_rebin
+from psrsigsim_tpu.utils import shift_t
+
+
+class TestFourierShift:
+    def test_matches_reference_shift_per_channel(self):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((8, 256)).astype(np.float32)
+        delays = rng.uniform(0, 20, 8)
+        dt = 0.5
+        batched = np.asarray(ops.fourier_shift(data, delays, dt=dt))
+        serial = np.stack([shift_t(row, d, dt=dt) for row, d in zip(data, delays)])
+        np.testing.assert_allclose(batched, serial, atol=2e-5)
+
+    def test_ensemble_batch_axis(self):
+        rng = np.random.default_rng(1)
+        data = rng.standard_normal((3, 4, 128)).astype(np.float32)
+        delays = rng.uniform(0, 5, (3, 4))
+        out = np.asarray(ops.fourier_shift(data, delays, dt=1.0))
+        for b in range(3):
+            single = np.asarray(ops.fourier_shift(data[b], delays[b], dt=1.0))
+            np.testing.assert_allclose(out[b], single, atol=1e-5)
+
+    def test_odd_length_preserved(self):
+        data = np.ones((2, 129), dtype=np.float32)
+        assert ops.fourier_shift(data, np.array([1.0, 2.0])).shape == (2, 129)
+
+    def test_zero_shift_identity(self):
+        rng = np.random.default_rng(2)
+        data = rng.standard_normal((4, 64)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(ops.fourier_shift(data, np.zeros(4))), data, atol=1e-5
+        )
+
+
+class TestCoherentDedispersion:
+    def test_unit_magnitude_transfer(self):
+        H = np.asarray(
+            ops.coherent_dedispersion_transfer(1024, 10.0, 1400.0, 100.0, 0.005)
+        )
+        np.testing.assert_allclose(np.abs(H), 1.0, atol=1e-5)
+
+    def test_matches_float64_numpy_model(self):
+        # parity with a float64 numpy transcription of L&K eq 5.21 as the
+        # reference applies it (per-channel rfft x H -> irfft)
+        rng = np.random.default_rng(3)
+        n = 2048
+        data = rng.standard_normal((2, n)).astype(np.float32)
+        dm, f0, bw, dt_us = 5.0, 1400.0, 200.0, 0.0025
+        out = np.asarray(ops.coherent_dedisperse(data, dm, f0, bw, dt_us))
+        f = np.fft.rfftfreq(n, d=dt_us) - bw / 2.0
+        phase = 2e6 * np.pi * (1 / 2.41e-4) * dm * f**2 / ((f + f0) * f0**2)
+        expect = np.fft.irfft(
+            np.fft.rfft(data.astype(np.float64), axis=-1) * np.exp(1j * phase),
+            n=n,
+            axis=-1,
+        )
+        np.testing.assert_allclose(out, expect, atol=1e-4)
+
+    def test_interior_spectrum_magnitude_preserved(self):
+        # |H| == 1, so away from the (real-constrained) DC/Nyquist bins the
+        # power spectrum must be untouched
+        rng = np.random.default_rng(30)
+        data = rng.standard_normal((1, 1024)).astype(np.float32)
+        out = ops.coherent_dedisperse(data, 10.0, 1400.0, 100.0, 0.005)
+        s_in = np.abs(np.fft.rfft(np.asarray(data), axis=-1))[:, 1:-1]
+        s_out = np.abs(np.fft.rfft(np.asarray(out), axis=-1))[:, 1:-1]
+        np.testing.assert_allclose(s_out, s_in, rtol=2e-2, atol=2e-3)
+
+    def test_dm_zero_identity(self):
+        rng = np.random.default_rng(4)
+        data = rng.standard_normal((1, 512)).astype(np.float32)
+        out = np.asarray(ops.coherent_dedisperse(data, 0.0, 1400.0, 100.0, 0.005))
+        np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+class TestPchip:
+    def test_matches_scipy_uniform_grid(self):
+        rng = np.random.default_rng(5)
+        x = np.arange(33) / 32.0
+        y = rng.standard_normal((4, 33))
+        coeffs = ops.pchip_fit(x, y)
+        xq = np.linspace(0, 1, 257)
+        ours = np.asarray(ops.pchip_eval(coeffs, xq))
+        theirs = PchipInterpolator(x, y, axis=1)(xq)
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+    def test_matches_scipy_nonuniform(self):
+        rng = np.random.default_rng(6)
+        x = np.sort(rng.uniform(0, 1, 16))
+        x[0], x[-1] = 0.0, 1.0
+        y = np.cumsum(rng.uniform(0, 1, (3, 16)), axis=1)
+        xq = rng.uniform(0, 1, 100)
+        ours = np.asarray(ops.pchip_eval(ops.pchip_fit(x, y), xq))
+        theirs = PchipInterpolator(x, y, axis=1)(xq)
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+    def test_monotone_preserving(self):
+        x = np.arange(10.0)
+        y = np.array([[0, 0, 0, 1, 5, 9, 10, 10, 10, 10.0]])
+        xq = np.linspace(0, 9, 500)
+        out = np.asarray(ops.pchip_eval(ops.pchip_fit(x, y), xq))
+        assert np.all(np.diff(out[0]) >= -1e-5)  # no overshoot oscillation
+        assert out.min() >= -1e-5 and out.max() <= 10 + 1e-5
+
+    def test_flat_segments_stay_flat(self):
+        # constant data -> constant interpolant (harmonic-mean zero guard)
+        x = np.arange(8.0)
+        y = np.full((2, 8), 3.0)
+        out = np.asarray(ops.pchip_eval(ops.pchip_fit(x, y), np.linspace(0, 7, 50)))
+        np.testing.assert_allclose(out, 3.0, atol=1e-6)
+
+    def test_two_point_linear(self):
+        out = np.asarray(
+            ops.pchip_eval(
+                ops.pchip_fit(np.array([0.0, 1.0]), np.array([[1.0, 3.0]])),
+                np.array([0.25, 0.5]),
+            )
+        )
+        np.testing.assert_allclose(out[0], [1.5, 2.0], atol=1e-6)
+
+
+class TestStats:
+    def test_chi2_moments(self):
+        import jax
+
+        key = jax.random.key(0)
+        for df in (1.0, 2.5, 37.8):
+            draws = np.asarray(ops.chi2_sample(key, df, (200_000,)))
+            assert draws.mean() == pytest.approx(df, rel=0.02)
+            assert draws.var() == pytest.approx(2 * df, rel=0.05)
+            assert (draws >= 0).all()
+
+    def test_chi2_matches_scipy_distribution(self):
+        import jax
+
+        draws = np.asarray(ops.chi2_sample(jax.random.key(1), 4.0, (100_000,)))
+        # Kolmogorov-Smirnov against the scipy CDF
+        stat, pval = spstats.kstest(draws, spstats.chi2(4.0).cdf)
+        assert pval > 1e-3
+
+    def test_draw_norm_float32_and_int8(self):
+        dm, dn = ops.chi2_draw_norm(np.float32, 1.0)
+        assert (dm, dn) == (200.0, 1.0)
+        dm8, dn8 = ops.chi2_draw_norm(np.int8, 2.0)
+        assert dm8 == 127.0
+        assert dn8 == pytest.approx(127.0 / spstats.chi2.ppf(0.999, 2.0))
+
+
+class TestResample:
+    def test_block_downsample_batched(self):
+        rng = np.random.default_rng(7)
+        data = rng.standard_normal((5, 120))
+        out = np.asarray(ops.block_downsample(data, 4))
+        for i in range(5):
+            np.testing.assert_allclose(
+                out[i], data[i].reshape(-1, 4).mean(axis=1), atol=1e-6
+            )
+
+    def test_rebin_matches_host_rebinner(self):
+        rng = np.random.default_rng(8)
+        data = rng.standard_normal((3, 100))
+        for newlen in (50, 33, 7):
+            ours = np.asarray(ops.rebin(data, newlen))
+            theirs = np.stack([np_rebin(row, newlen) for row in data])
+            np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+class TestConvolve:
+    def test_full_convolution_matches_scipy(self):
+        rng = np.random.default_rng(9)
+        a = rng.standard_normal((4, 64))
+        b = rng.standard_normal((4, 64))
+        ours = np.asarray(ops.fft_convolve_full(a, b))
+        theirs = np.stack(
+            [spsig.convolve(x, y, mode="full", method="fft") for x, y in zip(a, b)]
+        )
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+    def test_convolve_profiles_reference_semantics(self):
+        rng = np.random.default_rng(10)
+        nchan, nph = 6, 128
+        phases = np.arange(nph) / nph
+        profiles = np.exp(-0.5 * ((phases - 0.5) / 0.05) ** 2)[None, :].repeat(
+            nchan, axis=0
+        )
+        tails = np.exp(-phases / rng.uniform(0.01, 0.2, (nchan, 1)))
+        ours = np.asarray(ops.convolve_profiles(profiles, tails, nph))
+        # reference algorithm, per channel
+        expect = profiles.copy()
+        for ii in range(nchan):
+            ps = profiles[ii].sum()
+            ts = tails[ii].sum()
+            conv = spsig.convolve(
+                profiles[ii] / ps, tails[ii] / ts, mode="full", method="fft"
+            )
+            expect[ii] = ps * conv[:nph]
+        np.testing.assert_allclose(ours, expect, atol=1e-6)
+
+    def test_convolve_zero_sum_guard(self):
+        profiles = np.zeros((1, 16))
+        tails = np.ones((1, 16))
+        out = np.asarray(ops.convolve_profiles(profiles, tails, 16))
+        assert np.isfinite(out).all()
+
+
+class TestWindowFold:
+    def _reference_opw(self, profile, nphase):
+        # direct transcription of the published PyPulse-derived algorithm
+        ws = nphase / 8
+        integral = np.zeros_like(profile)
+        for i in range(nphase):
+            win = np.arange(i - ws // 2, i + ws // 2) % nphase
+            integral[i] = np.trapezoid(profile[win.astype(int)])
+        minind = np.argmin(integral)
+        opw = np.arange(minind - ws // 2, minind + ws // 2 + 1) % nphase
+        return opw.astype(int)
+
+    def test_offpulse_window_matches_reference(self):
+        for nph in (64, 100, 2048):
+            phases = np.arange(nph) / nph
+            profile = np.exp(-0.5 * ((phases - 0.3) / 0.02) ** 2)
+            ours = np.asarray(ops.offpulse_window(profile))
+            theirs = self._reference_opw(profile, nph)
+            np.testing.assert_array_equal(ours, theirs)
+
+    def test_offpulse_window_avoids_peak(self):
+        nph = 256
+        phases = np.arange(nph) / nph
+        profile = np.exp(-0.5 * ((phases - 0.5) / 0.05) ** 2)
+        opw = np.asarray(ops.offpulse_window(profile))
+        assert profile[opw].max() < 0.01
+
+    def test_fold_periods(self):
+        rng = np.random.default_rng(11)
+        nph, npulse = 32, 10
+        data = rng.standard_normal((4, nph * npulse + 7))
+        folded = np.asarray(ops.fold_periods(data, nph))
+        expect = data[:, : nph * npulse].reshape(4, npulse, nph).sum(axis=1)
+        np.testing.assert_allclose(folded, expect, atol=1e-6)
+
+
+class TestShiftPrecision:
+    """Review regressions: float32 phase precision on the shift paths."""
+
+    def test_large_delay_concrete_matches_float64(self):
+        # 260 ms delay at 1 us sampling: ~1e5 cycles at Nyquist
+        rng = np.random.default_rng(12)
+        n = 4096
+        data = rng.standard_normal((2, n)).astype(np.float32)
+        dt = 0.001  # ms
+        shift = 260.0  # ms
+        out = np.asarray(ops.fourier_shift(data, np.array([shift, shift]), dt=dt))
+        expect = np.stack([shift_t(row.astype(np.float64), shift, dt=dt) for row in data])
+        np.testing.assert_allclose(out, expect, atol=1e-4)
+
+    def test_large_delay_traced_bounded_error(self):
+        import jax
+
+        rng = np.random.default_rng(13)
+        n = 4096
+        data = rng.standard_normal((2, n)).astype(np.float32)
+        dt = 0.001
+        shifts = np.array([260.0, 130.0])
+        jitted = jax.jit(lambda d, s: ops.fourier_shift(d, s, dt=dt))
+        out = np.asarray(jitted(data, shifts))
+        expect = np.stack(
+            [shift_t(row.astype(np.float64), s, dt=dt) for row, s in zip(data, shifts)]
+        )
+        # traced path is input-precision-limited: phase err ~ (shift/dt)*eps_f32
+        # cycles (float32 shifts only carry ~relative-1e-7 delay information)
+        bound = (shifts.max() / dt) * np.finfo(np.float32).eps * 2 * np.pi * 2
+        assert np.abs(out - expect).max() < max(bound, 5e-3)
+
+    def test_zero_d_ndarray_dm_uses_host_path(self):
+        H_scalar = np.asarray(
+            ops.coherent_dedispersion_transfer(512, 10.0, 1400.0, 100.0, 0.005)
+        )
+        H_0d = np.asarray(
+            ops.coherent_dedispersion_transfer(512, np.asarray(10.0), 1400.0, 100.0, 0.005)
+        )
+        np.testing.assert_array_equal(H_scalar, H_0d)
