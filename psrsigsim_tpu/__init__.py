@@ -12,3 +12,20 @@ __version__ = "0.1.0"
 from . import utils  # noqa: F401
 
 __all__ = ["utils", "__version__"]
+
+
+def __getattr__(name):
+    # lazy submodule access keeps `import psrsigsim_tpu` light (no jax
+    # backend/device work at import time)
+    import importlib
+
+    if name in ("signal", "pulsar", "models", "ops", "ism", "telescope",
+                "simulate", "io", "parallel"):
+        try:
+            return importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as err:
+            # keep hasattr()/getattr(default) semantics intact
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}"
+            ) from err
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

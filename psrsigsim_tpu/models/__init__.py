@@ -1,0 +1,5 @@
+"""Domain models: pulsar emission, ISM propagation, telescope observation."""
+
+from . import pulsar
+
+__all__ = ["pulsar"]

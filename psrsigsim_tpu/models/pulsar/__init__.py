@@ -1,0 +1,17 @@
+"""Pulsar emission models (reference layer: psrsigsim/pulsar/)."""
+
+from .portraits import DataPortrait, GaussPortrait, PulsePortrait, UserPortrait
+from .profiles import DataProfile, GaussProfile, PulseProfile, UserProfile
+from .pulsar import Pulsar
+
+__all__ = [
+    "Pulsar",
+    "PulsePortrait",
+    "GaussPortrait",
+    "UserPortrait",
+    "DataPortrait",
+    "PulseProfile",
+    "GaussProfile",
+    "UserProfile",
+    "DataProfile",
+]

@@ -18,9 +18,18 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["pchip_slopes", "pchip_eval", "PchipCoeffs", "pchip_fit"]
+__all__ = [
+    "pchip_slopes",
+    "pchip_eval",
+    "PchipCoeffs",
+    "pchip_fit",
+    "pchip_fit_np",
+    "pchip_eval_np",
+]
 
 from typing import NamedTuple
+
+import numpy as np
 
 
 class PchipCoeffs(NamedTuple):
@@ -127,3 +136,35 @@ def pchip_eval(coeffs, xq):
     h01 = -2 * t3 + 3 * t2
     h11 = t3 - t2
     return y0 * h00 + d0 * (h * h10) + y1 * h01 + d1 * (h * h11)
+
+
+# -- host (scipy, float64) path used by config-time portrait construction --
+# Profile building runs once per configuration; scipy's PchipInterpolator IS
+# the reference's interpolant (portraits.py:252), so the host path delegates
+# to it — one source of truth, exact parity, float64 (no subnormal-tail
+# underflow).  The jax implementation above serves in-graph fitting only.
+
+
+def pchip_fit_np(x, y):
+    """Host float64 PCHIP fit via scipy.
+
+    Returns :class:`PchipCoeffs` whose slopes come from the scipy
+    interpolant's derivative at the breakpoints — identical Fritsch-Carlson
+    values, consumable by :func:`pchip_eval` on device.
+    """
+    from scipy.interpolate import PchipInterpolator
+
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    interp = PchipInterpolator(x, y, axis=-1)
+    slopes = interp.derivative()(x)  # (..., N), same layout as y
+    return PchipCoeffs(x=x, y=y, d=slopes)
+
+
+def pchip_eval_np(coeffs, xq):
+    """Host float64 PCHIP evaluation (scipy), matching :func:`pchip_eval`."""
+    from scipy.interpolate import PchipInterpolator
+
+    x, y, _ = coeffs
+    interp = PchipInterpolator(np.asarray(x), np.asarray(y), axis=-1)
+    return interp(np.asarray(xq, dtype=np.float64))

@@ -13,9 +13,26 @@ Positive shift delays the signal (reference sign convention).
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 
 __all__ = ["fourier_shift", "coherent_dedispersion_transfer", "coherent_dedisperse"]
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _apply_spectral_filter(data, filt_re, filt_im, n):
+    """rfft -> multiply -> irfft as one compiled program.
+
+    The filter arrives as separate real/imaginary float32 planes and becomes
+    complex only *inside* the graph: the axon TPU tunnel can neither execute
+    op-by-op complex arithmetic nor transfer complex arrays host<->device,
+    so complex values must be born and die on device.
+    """
+    spec = jnp.fft.rfft(data, axis=-1)
+    filt = jax.lax.complex(filt_re, filt_im).astype(spec.dtype)
+    return jnp.fft.irfft(spec * filt, n=n, axis=-1)
 
 
 def _is_concrete(x):
@@ -53,20 +70,26 @@ def fourier_shift(data, shifts, dt=1.0):
     import numpy as np
 
     n = data.shape[-1]
-    spec = jnp.fft.rfft(data, axis=-1)
-    shifts = jnp.asarray(shifts) if not _is_concrete(shifts) else np.asarray(shifts)
 
     if _is_concrete(shifts):
         freqs = np.fft.rfftfreq(n, d=float(dt))
         cycles = np.mod(freqs * np.asarray(shifts, np.float64)[..., None], 1.0)
-        phase = np.exp(-2j * np.pi * cycles).astype(np.complex64)
-        return jnp.fft.irfft(spec * jnp.asarray(phase), n=n, axis=-1)
+        re = np.cos(2 * np.pi * cycles).astype(np.float32)
+        im = (-np.sin(2 * np.pi * cycles)).astype(np.float32)
+        if _is_concrete(data):
+            return _apply_spectral_filter(data, jnp.asarray(re), jnp.asarray(im), n)
+        # data traced (inside an outer jit) but delays static: the float64
+        # host ramp becomes a compile-time constant
+        spec = jnp.fft.rfft(data, axis=-1)
+        phase = jax.lax.complex(jnp.asarray(re), jnp.asarray(im)).astype(spec.dtype)
+        return jnp.fft.irfft(spec * phase, n=n, axis=-1)
 
     # traced path: wrap the (circular) shift into one period so the phase
     # magnitude — and with it the float32 error, ~(n/2)·eps cycles — is
     # bounded by the transform length instead of the raw delay
+    spec = jnp.fft.rfft(data, axis=-1)
     period = n * dt
-    frac = jnp.mod(shifts, period)[..., None] / period  # in [0, 1)
+    frac = jnp.mod(jnp.asarray(shifts), period)[..., None] / period  # in [0, 1)
     k = jnp.arange(n // 2 + 1, dtype=spec.real.dtype)
     cycles = jnp.mod(k[None, :] * frac, 1.0)
     phase = jnp.exp((-2j * jnp.pi) * cycles)
@@ -81,8 +104,9 @@ def coherent_dedispersion_transfer(nsamp, dm, fcent_mhz, bw_mhz, dt_us):
     ``H = exp(+i 2π k_DM DM f² / ((f + f0) f0²))`` with ``f`` the baseband
     offset in ``[-bw/2, +bw/2]`` MHz and ``f0`` the band center in MHz.
 
-    Returns the rFFT-layout complex transfer function of length
-    ``nsamp//2 + 1``.
+    Returns ``(re, im)`` float planes of the rFFT-layout transfer function,
+    each of length ``nsamp//2 + 1`` (complex is assembled on device — see
+    :func:`_apply_spectral_filter`).
 
     Dispersion phases reach ~1e5-1e7 radians, far beyond float32's absolute
     phase resolution, so when ``dm`` is a concrete scalar (the normal API
@@ -96,14 +120,17 @@ def coherent_dedispersion_transfer(nsamp, dm, fcent_mhz, bw_mhz, dt_us):
     dm_k_s = 1.0 / 2.41e-4  # s MHz^2 cm^3 / pc
     if _is_concrete(dm) and np.ndim(dm) == 0:
         f = np.fft.rfftfreq(nsamp, d=dt_us) - bw_mhz / 2.0
-        phase = (
-            2.0e6 * np.pi * dm_k_s * dm * f**2 / ((f + fcent_mhz) * fcent_mhz**2)
+        phase = np.mod(
+            2.0e6 * np.pi * dm_k_s * dm * f**2 / ((f + fcent_mhz) * fcent_mhz**2),
+            2 * np.pi,
         )
-        return jnp.asarray(np.exp(1j * np.mod(phase, 2 * np.pi)).astype(np.complex64))
+        # real/imag float planes: complex arrays can't cross the host<->device
+        # boundary on all backends (see _apply_spectral_filter)
+        return np.cos(phase).astype(np.float32), np.sin(phase).astype(np.float32)
     u = jnp.fft.rfftfreq(nsamp, d=dt_us)  # cycles/us == MHz
     f = u - bw_mhz / 2.0
     phase = 2.0e6 * jnp.pi * dm_k_s * dm * f**2 / ((f + fcent_mhz) * fcent_mhz**2)
-    return jnp.exp(1j * phase)
+    return jnp.cos(phase), jnp.sin(phase)
 
 
 def coherent_dedisperse(data, dm, fcent_mhz, bw_mhz, dt_us):
@@ -113,6 +140,9 @@ def coherent_dedisperse(data, dm, fcent_mhz, bw_mhz, dt_us):
     channels serially, psrsigsim/ism/ism.py:82-98).
     """
     n = data.shape[-1]
-    H = coherent_dedispersion_transfer(n, dm, fcent_mhz, bw_mhz, dt_us)
+    re, im = coherent_dedispersion_transfer(n, dm, fcent_mhz, bw_mhz, dt_us)
+    if _is_concrete(data) and _is_concrete(re):
+        return _apply_spectral_filter(data, jnp.asarray(re), jnp.asarray(im), n)
     spec = jnp.fft.rfft(data, axis=-1)
+    H = jax.lax.complex(jnp.asarray(re), jnp.asarray(im)).astype(spec.dtype)
     return jnp.fft.irfft(spec * H, n=n, axis=-1)

@@ -1,0 +1,24 @@
+"""Signal data model: pytree state + reference-parity signal classes
+(reference layer: psrsigsim/signal/)."""
+
+from .signals import (
+    BasebandSignal,
+    BaseSignal,
+    FilterBankSignal,
+    RFSignal,
+    Signal,
+)
+from .state import FLOAT32, INT8, SignalMeta, SignalState, empty_state
+
+__all__ = [
+    "Signal",
+    "BaseSignal",
+    "RFSignal",
+    "BasebandSignal",
+    "FilterBankSignal",
+    "SignalMeta",
+    "SignalState",
+    "empty_state",
+    "FLOAT32",
+    "INT8",
+]

@@ -1,0 +1,109 @@
+"""Signal state as a JAX pytree + static metadata.
+
+The reference's data model is a mutable object whose ``._data`` every pipeline
+stage overwrites in place, with hidden state flags accumulating on the side
+(`_delay`, `_dispersed`, `_Smax`; see SURVEY.md §1).  That shape is hostile to
+XLA, so the TPU-native core splits it:
+
+* :class:`SignalState` — the dynamic leaves (sample data, accumulated delay)
+  that flow through jit/vmap/pjit as one pytree.
+* :class:`SignalMeta` — frozen, hashable trace-time constants (band geometry,
+  sampling, fold config, dtype tag).  Shapes derive from these on host,
+  so everything under jit is static-shaped.
+
+The user-facing classes in :mod:`psrsigsim_tpu.signal.signals` are thin
+mutable shells over these for reference API parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SignalMeta", "SignalState", "FLOAT32", "INT8"]
+
+# dtype tags kept as strings so SignalMeta stays hashable
+FLOAT32 = "float32"
+INT8 = "int8"
+
+
+@dataclasses.dataclass(frozen=True)
+class SignalMeta:
+    """Static signal configuration (hashable; safe as a jit static arg).
+
+    Canonical units: MHz for frequencies/rates, seconds for durations.
+    Mirrors the metadata surface of the reference's BaseSignal/
+    FilterBankSignal (signal/signal.py:43-71, signal/fb_signal.py:64-112).
+    """
+
+    sigtype: str  # "FilterBankSignal" | "BasebandSignal" | "RFSignal"
+    fcent_mhz: float
+    bw_mhz: float
+    samprate_mhz: float
+    nchan: int
+    npols: int = 1
+    dtype: str = FLOAT32
+    fold: bool = True
+    sublen_s: Optional[float] = None
+
+    # ---- derived, host-side ----
+    def dat_freq_mhz(self):
+        """Channel center grid: ``arange(fcent-bw/2, fcent+bw/2, bw/nchan)``
+        (reference: fb_signal.py:101-106)."""
+        first = self.fcent_mhz - self.bw_mhz / 2
+        last = self.fcent_mhz + self.bw_mhz / 2
+        step = self.bw_mhz / self.nchan
+        return np.arange(first, last, step)
+
+    def nsamp_for(self, tobs_s):
+        """Samples per channel for an observation of ``tobs_s`` seconds."""
+        return int(tobs_s * self.samprate_mhz * 1e6)
+
+    @property
+    def np_dtype(self):
+        return np.int8 if self.dtype == INT8 else np.float32
+
+
+@jax.tree_util.register_pytree_node_class
+class SignalState:
+    """Dynamic signal contents: ``data (..., Nchan, Nsamp)`` and the
+    accumulated per-channel ``delay_ms (..., Nchan)`` (None before any
+    propagation stage; the reference accumulates the same way,
+    ism/ism.py:44-47,123-126,190-193)."""
+
+    __slots__ = ("data", "delay_ms")
+
+    def __init__(self, data, delay_ms=None):
+        self.data = data
+        self.delay_ms = delay_ms
+
+    def tree_flatten(self):
+        return (self.data, self.delay_ms), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def replace(self, **kw):
+        return SignalState(
+            data=kw.get("data", self.data),
+            delay_ms=kw.get("delay_ms", self.delay_ms),
+        )
+
+    def add_delay(self, delay_ms):
+        """Accumulate a per-channel delay vector (ms)."""
+        new = delay_ms if self.delay_ms is None else self.delay_ms + delay_ms
+        return self.replace(delay_ms=new)
+
+    def __repr__(self):
+        shape = getattr(self.data, "shape", None)
+        return f"SignalState(data{shape}, delay={'set' if self.delay_ms is not None else 'None'})"
+
+
+def empty_state(meta, nsamp):
+    """Allocate a zeroed device buffer for ``(Nchan, nsamp)``."""
+    return SignalState(data=jnp.zeros((meta.nchan, nsamp), dtype=jnp.float32))

@@ -1,0 +1,175 @@
+"""Tests for portraits/profiles (mirrors reference tests/test_portraits.py
+scope, plus scipy parity for the PCHIP data path)."""
+
+import numpy as np
+import pytest
+from scipy.interpolate import PchipInterpolator
+
+from psrsigsim_tpu.pulsar import (
+    DataPortrait,
+    DataProfile,
+    GaussPortrait,
+    GaussProfile,
+    UserPortrait,
+    UserProfile,
+)
+from psrsigsim_tpu.models.pulsar.portraits import (
+    _gaussian_mult_1d,
+    _gaussian_sing_1d,
+)
+
+
+class TestGaussPortrait:
+    def test_init_profiles_normalized(self):
+        port = GaussPortrait(peak=0.5, width=0.05, amp=1.0)
+        port.init_profiles(256, Nchan=4)
+        assert port.profiles.shape == (4, 256)
+        assert port.profiles.max() == pytest.approx(1.0)
+        assert port._max_profile.shape == (256,)
+        assert port._max_profile.max() == pytest.approx(1.0)
+
+    def test_call_without_init_warns(self, capsys):
+        port = GaussPortrait()
+        assert port() is None
+        assert "not generated" in capsys.readouterr().out
+
+    def test_call_with_phases_requires_nchan(self):
+        # __call__(phases) -> calc_profiles(phases, Nchan=None): scalar params
+        # without Nchan raise, matching the reference
+        port = GaussPortrait(peak=0.5, width=0.05, amp=1.0)
+        with pytest.raises(ValueError):
+            port(np.array([0.5]))
+
+    def test_requires_nchan_for_scalar_params(self):
+        with pytest.raises(ValueError):
+            GaussPortrait().calc_profiles(np.linspace(0, 1, 10))
+
+    def test_multi_component_1d(self):
+        port = GaussPortrait(
+            peak=np.array([0.25, 0.75]),
+            width=np.array([0.05, 0.05]),
+            amp=np.array([1.0, 0.5]),
+        )
+        port.init_profiles(512, Nchan=2)
+        prof = port._max_profile
+        # two peaks, second at half amplitude
+        assert prof[128] == pytest.approx(1.0, abs=1e-3)
+        assert prof[384] == pytest.approx(0.5, abs=1e-3)
+
+    def test_amax_cached_across_calls(self):
+        port = GaussPortrait(peak=0.5, width=0.05, amp=2.0)
+        first = port.calc_profiles(np.linspace(0, 1, 100), Nchan=1)
+        assert first.max() == pytest.approx(1.0, abs=1e-4)
+        # a second call on a coarser grid reuses the cached Amax
+        second = port.calc_profiles(np.array([0.5]), Nchan=1)
+        assert second[0, 0] == pytest.approx(2.0 / port.Amax)
+
+    def test_phase_range_validation(self):
+        with pytest.raises(ValueError):
+            _gaussian_sing_1d(np.array([1.5]), 0.5, 0.05, 1.0)
+        with pytest.raises(ValueError):
+            _gaussian_mult_1d(
+                np.array([-0.1]), np.array([0.5]), np.array([0.05]), np.array([1.0])
+            )
+
+    def test_gaussian_helper_values(self):
+        ph = np.linspace(0, 1, 11)
+        out = _gaussian_sing_1d(ph, 0.5, 0.1, 2.0)
+        np.testing.assert_allclose(out, 2.0 * np.exp(-0.5 * ((ph - 0.5) / 0.1) ** 2))
+
+
+class TestDataPortrait:
+    def _portrait_data(self, nchan=4, nph=128):
+        ph = np.arange(nph) / nph
+        return np.stack(
+            [np.exp(-0.5 * ((ph - 0.4 - 0.01 * i) / 0.03) ** 2) for i in range(nchan)]
+        )
+
+    def test_scipy_parity_on_eval(self):
+        profs = self._portrait_data()
+        port = DataPortrait(profs.copy())
+        xq = np.linspace(0, 0.99, 333)
+        ours = port.calc_profiles(xq)
+        # reproduce the reference's periodicity fix-up + scipy PCHIP
+        ref_profs = np.append(profs, profs[:, :1], axis=1)
+        ref_phases = np.arange(129) / 128
+        theirs = PchipInterpolator(ref_phases, ref_profs, axis=1)(xq)
+        theirs /= theirs.max()
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+    def test_periodicity_enforced(self):
+        profs = self._portrait_data()
+        port = DataPortrait(profs.copy())
+        left = port.calc_profiles(np.array([0.0]))
+        right = port.calc_profiles(np.array([1.0]))
+        np.testing.assert_allclose(left, right, atol=1e-6)
+
+    def test_negative_bins_zeroed(self, capsys):
+        profs = self._portrait_data()
+        profs[0, 5] = -1.0
+        port = DataPortrait(profs)
+        assert "negative" in capsys.readouterr().out
+        assert port.calc_profiles(np.arange(128) / 128).min() >= -1e-6
+
+    def test_explicit_phases_periodicity(self):
+        nph = 64
+        phases = np.arange(nph) / nph
+        profs = self._portrait_data(nchan=2, nph=nph)
+        port = DataPortrait(profs.copy(), phases=phases)
+        out = port.calc_profiles(np.array([0.0, 1.0]))
+        np.testing.assert_allclose(out[:, 0], out[:, 1], atol=1e-6)
+
+    def test_init_profiles_max_profile(self):
+        port = DataPortrait(self._portrait_data())
+        port.init_profiles(128, Nchan=4)
+        assert port.profiles.max() == pytest.approx(1.0)
+        assert port._max_profile.max() == pytest.approx(1.0)
+
+
+class TestProfileWrappers:
+    def test_gauss_profile_defaults(self):
+        prof = GaussProfile()
+        assert prof.peak == 0.5
+        assert prof.width == 0.05
+        assert prof.amp == 1
+        prof.init_profiles(128, Nchan=2)
+        assert prof.profiles.shape == (2, 128)
+
+    def test_user_profile_callable(self):
+        func = lambda ph: np.exp(-0.5 * ((ph - 0.3) / 0.1) ** 2)
+        prof = UserProfile(func)
+        out = prof.calc_profile(np.linspace(0, 1, 100))
+        assert out.max() == pytest.approx(1.0)
+        profs = prof.calc_profiles(np.linspace(0, 1, 100), Nchan=3)
+        assert profs.shape == (3, 100)
+
+    def test_user_portrait_stub(self):
+        with pytest.raises(NotImplementedError):
+            UserPortrait()
+
+    def test_data_profile_tiles_1d(self):
+        ph = np.arange(64) / 64
+        prof_1d = np.exp(-0.5 * ((ph - 0.5) / 0.05) ** 2)
+        prof = DataProfile(prof_1d, Nchan=8)
+        prof.init_profiles(64, Nchan=8)
+        assert prof.profiles.shape == (8, 64)
+
+    def test_data_profile_default_single_channel(self):
+        ph = np.arange(64) / 64
+        prof = DataProfile(np.exp(-0.5 * ((ph - 0.5) / 0.05) ** 2))
+        prof.init_profiles(64)
+        assert prof.profiles.shape == (1, 64)
+
+    def test_set_nchan_stubs(self):
+        with pytest.raises(NotImplementedError):
+            GaussProfile().set_Nchan(4)
+        ph = np.arange(16) / 16.0
+        with pytest.raises(NotImplementedError):
+            DataProfile(np.ones(16), Nchan=1).set_Nchan(4)
+
+    def test_offpulse_window(self):
+        prof = GaussProfile(peak=0.5, width=0.02)
+        prof.init_profiles(256, Nchan=1)
+        opw = prof._calcOffpulseWindow(Nphase=256)
+        assert len(opw) == 2 * (256 // 8 // 2) + 1
+        assert prof._max_profile[opw.astype(int)].max() < 1e-6

@@ -1,0 +1,188 @@
+"""Tests for Pulsar pulse synthesis (mirrors reference tests/test_pulsar.py
+scope, plus statistical-moment checks the reference lacks)."""
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.pulsar import DataProfile, GaussProfile, Pulsar
+from psrsigsim_tpu.signal import BasebandSignal, FilterBankSignal
+from psrsigsim_tpu.utils import make_quant, set_seed
+
+
+@pytest.fixture
+def fold_sig():
+    return FilterBankSignal(
+        1400, 400, Nsubband=2, sample_rate=186.49408124993144 * 2048 * 1e-6,
+        sublen=0.5, fold=True,
+    )
+
+
+@pytest.fixture
+def nofold_sig():
+    return FilterBankSignal(
+        1400, 400, Nsubband=2, sample_rate=186.49408124993144 * 2048 * 1e-6,
+        fold=False,
+    )
+
+
+@pytest.fixture
+def psr():
+    return Pulsar(period=1.0 / 186.49408124993144, Smean=1.0,
+                  profiles=GaussProfile(), name="J1746-0118", seed=42)
+
+
+class TestMakePulsesFold:
+    def test_shapes_and_metadata(self, fold_sig, psr):
+        tobs = 2.0
+        psr.make_pulses(fold_sig, tobs=tobs)
+        assert fold_sig.nsub == 4  # round(2.0 / 0.5)
+        nph = int((fold_sig.samprate * psr.period).decompose())
+        assert fold_sig.data.shape == (2, fold_sig.nsub * nph)
+        assert fold_sig.Nfold == pytest.approx(
+            float((fold_sig.sublen / psr.period).decompose())
+        )
+        assert fold_sig.tobs.to("s").value == tobs
+        assert fold_sig._Smax.to("Jy").value > 0
+
+    def test_sublen_none_single_subint(self, psr):
+        sig = FilterBankSignal(1400, 400, Nsubband=2, fold=True)
+        psr.make_pulses(sig, tobs=0.02)
+        assert sig.nsub == 1
+        assert sig.sublen.to("s").value == pytest.approx(0.02)
+
+    def test_fold_mode_mean_matches_chi2(self, fold_sig, psr):
+        # data = profile * chi2(Nfold) draws; E[data] = profile * Nfold
+        psr.make_pulses(fold_sig, tobs=2.0)
+        nph = int((fold_sig.samprate * psr.period).decompose())
+        data = np.asarray(fold_sig.data).reshape(2, fold_sig.nsub, nph)
+        prof = psr.Profiles.profiles[0]
+        mean_ratio = data.mean(axis=1)[0, prof > 0.5] / (
+            prof[prof > 0.5] * fold_sig.Nfold
+        )
+        assert mean_ratio.mean() == pytest.approx(1.0, rel=0.25)
+
+    def test_seeded_reproducibility(self, fold_sig):
+        p1 = Pulsar(0.005, 1.0, GaussProfile(), seed=7)
+        p1.make_pulses(fold_sig, tobs=2.0)
+        d1 = np.asarray(fold_sig.data)
+        sig2 = FilterBankSignal(
+            1400, 400, Nsubband=2,
+            sample_rate=186.49408124993144 * 2048 * 1e-6, sublen=0.5, fold=True,
+        )
+        p2 = Pulsar(0.005, 1.0, GaussProfile(), seed=7)
+        p2.make_pulses(sig2, tobs=2.0)
+        np.testing.assert_array_equal(d1, np.asarray(sig2.data))
+
+    def test_spectral_index_scales_profiles(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=4, sublen=0.5, fold=True)
+        psr = Pulsar(0.005, 1.0, GaussProfile(), specidx=-2.0, ref_freq=1400.0,
+                     seed=3)
+        psr.make_pulses(sig, tobs=1.0)
+        # after spectral index, Profiles was re-wrapped as a DataPortrait
+        from psrsigsim_tpu.pulsar import DataPortrait
+
+        assert isinstance(psr.Profiles, DataPortrait)
+        profs = psr.Profiles.profiles
+        # steep negative index: lowest channel (1250 MHz) brighter than
+        # highest (1550+): peak ratio ~ (f_lo/f_hi)^-2
+        peaks = profs.max(axis=1)
+        assert peaks[0] > peaks[-1]
+
+
+class TestMakePulsesSingle:
+    def test_shapes(self, nofold_sig, psr):
+        psr.make_pulses(nofold_sig, tobs=0.05)
+        nsamp = int((nofold_sig.tobs * nofold_sig.samprate).decompose())
+        assert nofold_sig.data.shape == (2, nsamp)
+        assert nofold_sig.nsub == int(
+            np.round(float((nofold_sig.tobs / psr.period).decompose()))
+        )
+
+    def test_single_pulse_mean_matches_chi2_df1(self, nofold_sig, psr):
+        psr.make_pulses(nofold_sig, tobs=0.1)
+        data = np.asarray(nofold_sig.data)
+        prof = psr.Profiles.calc_profiles(
+            np.arange(data.shape[1], dtype=np.float64)
+            / float((nofold_sig.samprate * psr.period).decompose())
+            % 1.0,
+            Nchan=2,
+        )
+        on = prof[0] > 0.5
+        ratio = data[0, on].mean() / prof[0, on].mean()
+        assert ratio == pytest.approx(1.0, rel=0.2)  # chi2(1) mean = 1
+
+
+class TestMakePulsesAmplitude:
+    def test_baseband_amp_pulses(self, psr):
+        sig = BasebandSignal(1400, 20, sample_rate=40.0, Nchan=2)
+        psr.make_pulses(sig, tobs=0.005)
+        nsamp = int((sig.tobs * sig.samprate).decompose())
+        assert sig.data.shape == (2, nsamp)
+        data = np.asarray(sig.data)
+        # amplitude draws: zero-mean where profile is nonzero
+        assert abs(data.mean()) < 0.05
+        # variance follows the intensity profile
+        assert data.var() > 0
+
+
+class TestSmaxAndRefFreq:
+    def test_ref_freq_defaults_to_fcent(self, fold_sig, psr):
+        psr.make_pulses(fold_sig, tobs=1.0)
+        assert psr.ref_freq.to("MHz").value == pytest.approx(1400.0)
+
+    def test_smax_formula(self, fold_sig, psr):
+        psr.make_pulses(fold_sig, tobs=1.0)
+        pr = psr.Profiles._max_profile
+        expect = 1.0 * len(pr) / np.sum(pr)
+        assert fold_sig._Smax.to("Jy").value == pytest.approx(expect)
+
+
+class TestNulling:
+    def _make(self, seed=11, nsub=8):
+        sig = FilterBankSignal(1400, 400, Nsubband=2, sublen=0.25, fold=True)
+        psr = Pulsar(0.005, 1.0, GaussProfile(width=0.05), seed=seed)
+        psr.make_pulses(sig, tobs=nsub * 0.25)
+        return sig, psr
+
+    def test_null_half(self):
+        sig, psr = self._make()
+        nph = int((sig.samprate * psr.period).decompose())
+        before = np.asarray(sig.data).reshape(2, sig.nsub, nph)
+        psr.null(sig, 0.5)
+        after = np.asarray(sig.data).reshape(2, sig.nsub, nph)
+        on_mask = psr.Profiles._max_profile > 0.5
+        b = before[0, :, on_mask].mean(axis=0)
+        a = after[0, :, on_mask].mean(axis=0)
+        nulled = (a / b) < 0.1
+        assert nulled.sum() == int(np.round(sig.nsub * 0.5))
+
+    def test_null_zero_fraction_noop(self):
+        sig, psr = self._make()
+        before = np.asarray(sig.data)
+        psr.null(sig, 0.0)
+        np.testing.assert_array_equal(before, np.asarray(sig.data))
+
+    def test_null_dispersed_signal(self):
+        sig, psr = self._make()
+        # mimic a dispersed signal: set an accumulated delay
+        sig.delay = make_quant(np.array([1.2, 3.4]), "ms")
+        before = np.asarray(sig.data).copy()
+        psr.null(sig, 0.25)
+        after = np.asarray(sig.data)
+        assert not np.array_equal(before, after)
+        assert np.isfinite(after).all()
+
+    def test_length_frequency_not_implemented(self):
+        sig, psr = self._make()
+        with pytest.raises(NotImplementedError):
+            psr.null(sig, 0.5, length=1.0)
+
+    def test_data_profile_pulsar(self):
+        # make pulses from an empirical profile (DataProfile path)
+        ph = np.arange(128) / 128
+        template = np.exp(-0.5 * ((ph - 0.5) / 0.03) ** 2)
+        sig = FilterBankSignal(1400, 200, Nsubband=4, sublen=0.5, fold=True)
+        psr = Pulsar(0.005, 2.0, DataProfile(template, Nchan=4), seed=5)
+        psr.make_pulses(sig, tobs=1.0)
+        assert np.isfinite(np.asarray(sig.data)).all()
+        assert np.asarray(sig.data).max() > 0

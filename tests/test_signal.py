@@ -1,0 +1,170 @@
+"""Tests for the signal layer (mirrors reference tests/test_signal.py scope)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psrsigsim_tpu.signal import (
+    BasebandSignal,
+    FilterBankSignal,
+    RFSignal,
+    Signal,
+    SignalMeta,
+    SignalState,
+)
+
+
+class TestFilterBankSignal:
+    def test_ctor_defaults(self):
+        s = FilterBankSignal(1400, 400)
+        assert s.sigtype == "FilterBankSignal"
+        assert s.Nchan == 512
+        assert s.fcent.value == 1400
+        assert s.bw.value == 400
+        assert s.samprate.to("MHz").value == pytest.approx(1 / 20.48)
+        assert s.fold is True
+        assert s.sublen is None
+        assert s.Npols == 1
+        assert s.dtype is np.float32
+        assert s.delay is None
+        assert s.dm is None
+
+    def test_dat_freq_grid(self):
+        s = FilterBankSignal(1400, 400, Nsubband=64)
+        freqs = s.dat_freq.value
+        assert len(freqs) == 64
+        assert freqs[0] == pytest.approx(1200.0)
+        assert freqs[-1] == pytest.approx(1400 + 200 - 400 / 64)
+
+    def test_negative_bandwidth_abs(self):
+        s = FilterBankSignal(1400, -400)
+        assert s.bw.value == 400
+
+    def test_sub_nyquist_warning(self, capsys):
+        FilterBankSignal(1400, 400, sample_rate=10.0)
+        assert "Nyquist" in capsys.readouterr().out
+
+    def test_fold_sublen(self):
+        s = FilterBankSignal(1400, 200, sublen=2.0)
+        assert s.sublen.to("s").value == 2.0
+
+    def test_float32_draw_norm(self):
+        s = FilterBankSignal(1400, 400, dtype=np.float32)
+        assert s._draw_max == 200.0
+        assert s._draw_norm == 1.0
+
+    def test_int8_draw_norm(self):
+        from scipy import stats
+
+        s = FilterBankSignal(1400, 400, dtype=np.int8)
+        assert s.dtype is np.int8
+        assert s._draw_max == 127.0
+        assert s._draw_norm == pytest.approx(127.0 / stats.chi2.ppf(0.999, 1))
+        s._set_draw_norm(df=12.5)
+        assert s._draw_norm == pytest.approx(127.0 / stats.chi2.ppf(0.999, 12.5))
+
+    def test_bad_dtype_rejected(self):
+        # divergence #1: the intended check, enforced
+        with pytest.raises(ValueError):
+            FilterBankSignal(1400, 400, dtype=np.float64)
+
+    def test_bad_npols_rejected(self):
+        from psrsigsim_tpu.signal import BaseSignal
+
+        with pytest.raises(ValueError):
+            BaseSignal(1400, 400, Npols=3)
+
+    def test_init_data_and_device_buffer(self):
+        s = FilterBankSignal(1400, 400, Nsubband=16)
+        s.init_data(1024)
+        assert s.data.shape == (16, 1024)
+        assert isinstance(s.data, jax.Array)
+        assert s.nsamp == 1024
+
+    def test_to_filterbank_identity(self):
+        s = FilterBankSignal(1400, 400)
+        assert s.to_FilterBank() is s
+        with pytest.raises(NotImplementedError):
+            s.to_RF()
+        with pytest.raises(NotImplementedError):
+            s.to_Baseband()
+
+    def test_meta_is_static_and_hashable(self):
+        s = FilterBankSignal(1430, 100, Nsubband=64, sublen=1.0)
+        meta = s.meta()
+        assert isinstance(meta, SignalMeta)
+        hash(meta)
+        assert meta.nchan == 64
+        assert meta.fold is True
+        assert meta.sublen_s == 1.0
+        np.testing.assert_allclose(meta.dat_freq_mhz(), s.dat_freq.value)
+
+
+class TestBasebandSignal:
+    def test_ctor_nyquist_default(self):
+        s = BasebandSignal(1400, 400)
+        assert s.samprate.to("MHz").value == pytest.approx(800.0)
+        assert s.Nchan == 2
+        assert s.sigtype == "BasebandSignal"
+
+    def test_sub_nyquist_warning(self, capsys):
+        BasebandSignal(1400, 400, sample_rate=100.0)
+        assert "Nyquist" in capsys.readouterr().out
+
+    def test_conversions(self):
+        s = BasebandSignal(1400, 400)
+        assert s.to_Baseband() is s
+        with pytest.raises(NotImplementedError):
+            s.to_RF()
+        with pytest.raises(NotImplementedError):
+            s.to_FilterBank()
+
+
+class TestRFSignal:
+    def test_ctor_nyquist_default(self):
+        s = RFSignal(1400, 400)
+        assert s.samprate.to("MHz").value == pytest.approx(2 * (1400 + 200))
+        assert s.sigtype == "RFSignal"
+
+    def test_conversions(self):
+        s = RFSignal(1400, 400)
+        assert s.to_RF() is s
+        with pytest.raises(NotImplementedError):
+            s.to_Baseband()
+        with pytest.raises(NotImplementedError):
+            s.to_FilterBank()
+
+
+class TestSignalFactoryAndState:
+    def test_signal_factory_stub(self):
+        with pytest.raises(NotImplementedError):
+            Signal()
+
+    def test_add_not_implemented(self):
+        with pytest.raises(NotImplementedError):
+            FilterBankSignal(1400, 400) + FilterBankSignal(1400, 400)
+
+    def test_state_is_pytree(self):
+        state = SignalState(data=jnp.ones((4, 8)), delay_ms=jnp.zeros(4))
+        leaves = jax.tree_util.tree_leaves(state)
+        assert len(leaves) == 2
+        doubled = jax.tree_util.tree_map(lambda x: 2 * x, state)
+        assert isinstance(doubled, SignalState)
+        np.testing.assert_allclose(np.asarray(doubled.data), 2.0)
+
+    def test_state_jits(self):
+        @jax.jit
+        def stage(st):
+            return st.add_delay(jnp.ones(4)).replace(data=st.data + 1)
+
+        out = stage(SignalState(data=jnp.zeros((4, 8))))
+        np.testing.assert_allclose(np.asarray(out.data), 1.0)
+        np.testing.assert_allclose(np.asarray(out.delay_ms), 1.0)
+
+    def test_delay_accumulates(self):
+        st = SignalState(data=jnp.zeros((2, 4)))
+        st = st.add_delay(jnp.array([1.0, 2.0]))
+        st = st.add_delay(jnp.array([0.5, 0.5]))
+        np.testing.assert_allclose(np.asarray(st.delay_ms), [1.5, 2.5])
