@@ -1,0 +1,6 @@
+"""Reference-parity import alias: ``psrsigsim_tpu.ism`` mirrors
+``psrsigsim.ism``."""
+
+from ..models.ism import ISM
+
+__all__ = ["ISM"]

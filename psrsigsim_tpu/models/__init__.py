@@ -1,5 +1,5 @@
 """Domain models: pulsar emission, ISM propagation, telescope observation."""
 
-from . import pulsar
+from . import ism, pulsar, telescope
 
-__all__ = ["pulsar"]
+__all__ = ["pulsar", "ism", "telescope"]

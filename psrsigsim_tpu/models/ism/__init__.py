@@ -1,0 +1,5 @@
+"""ISM propagation models (reference layer: psrsigsim/ism/)."""
+
+from .ism import ISM
+
+__all__ = ["ISM"]

@@ -1,0 +1,170 @@
+"""Telescope receiver: bandpass + radiometer noise.
+
+Behavioral counterpart of psrsigsim/telescope/receiver.py.  Noise levels
+follow Lorimer & Kramer eq 7.12 with the Lam et al. 2018a profile-
+normalization scaling; the scipy global-RNG draws over ``(Nchan, Nsamp)``
+(receiver.py:136,170) become one jitted explicit-key device sample.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.stats import chi2_sample, normal_sample
+from ...utils.quantity import make_quant
+from ...utils.rng import KeySequence, default_keys
+
+__all__ = ["Receiver", "response_from_data"]
+
+
+@jax.jit
+def _add_pow_noise_kernel(key, data, df, norm):
+    return data + chi2_sample(key, df, data.shape) * norm
+
+
+@jax.jit
+def _add_amp_noise_kernel(key, data, norm):
+    return data + normal_sample(key, data.shape) * norm
+
+
+class Receiver:
+    """A receiver: flat bandpass (fcent/bandwidth) + receiver temperature
+    (reference: receiver.py:12-57).
+
+    Required: EITHER a callable ``response`` (not yet implemented upstream or
+    here) OR ``fcent`` and ``bandwidth`` for a flat response.
+    """
+
+    def __init__(self, response=None, fcent=None, bandwidth=None, Trec=35,
+                 name=None, seed=None):
+        if response is None:
+            if fcent is None or bandwidth is None:
+                raise ValueError("specify EITHER response OR fcent and bandwidth")
+            self._response = _flat_response(fcent, bandwidth)
+        else:
+            if fcent is not None or bandwidth is not None:
+                raise ValueError("specify EITHER response OR fcent and bandwidth")
+            raise NotImplementedError("Non-flat response not yet implemented.")
+
+        self._Trec = make_quant(Trec, "K")
+        self._name = name
+        self._fcent = make_quant(fcent, "MHz")
+        self._bandwidth = make_quant(bandwidth, "MHz")
+        self._keys = KeySequence(seed) if seed is not None else default_keys
+
+    def __repr__(self):
+        return "Receiver({:s})".format(self._name)
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def Trec(self):
+        return self._Trec
+
+    @property
+    def response(self):
+        return self._response
+
+    @property
+    def fcent(self):
+        return self._fcent
+
+    @property
+    def bandwidth(self):
+        return self._bandwidth
+
+    def _resolve_tsys(self, Tsys, Tenv):
+        """Tsys = Tenv + Trec, unless Tsys given (just Trec if neither)
+        (reference: receiver.py:100-108)."""
+        tsys_val = Tsys.value if hasattr(Tsys, "value") else Tsys
+        tenv_val = Tenv.value if hasattr(Tenv, "value") else Tenv
+        if tsys_val is None and tenv_val is None:
+            return self.Trec
+        if tenv_val is not None:
+            if tsys_val is not None:
+                raise ValueError("specify EITHER Tsys OR Tenv, not both")
+            return make_quant(Tenv, "K") + self.Trec
+        return make_quant(Tsys, "K")
+
+    def radiometer_noise(self, signal, pulsar, gain=1, Tsys=None, Tenv=None):
+        """Add radiometer noise to the signal in place
+        (reference: receiver.py:82-121)."""
+        Tsys = self._resolve_tsys(Tsys, Tenv)
+        gain = make_quant(gain, "K/Jy")
+
+        if signal.sigtype in ["RFSignal", "BasebandSignal"]:
+            self._add_amp_noise(signal, Tsys, gain, pulsar)
+        elif signal.sigtype == "FilterBankSignal":
+            self._add_pow_noise(signal, Tsys, gain, pulsar)
+        else:
+            raise NotImplementedError(
+                "no pulse method for signal: {}".format(signal.sigtype)
+            )
+
+    def _amp_noise_norm(self, signal, Tsys, gain, pulsar):
+        """Amplitude-signal noise scale (reference: receiver.py:123-138).
+
+        Reproduces the reference numerically, including its unit quirk:
+        U_scale = 1/(sum(max_profile)/samprate) carries a stray MHz that
+        ``.value`` silently drops (receiver.py:133-138).
+        """
+        dt = 1 / signal.samprate
+        sigS = Tsys / gain / np.sqrt(2 * dt * signal.bw)
+        u_scale = float(signal.samprate.to("MHz").value) / float(
+            np.sum(pulsar.Profiles._max_profile)
+        )
+        return float(
+            np.sqrt(float((sigS / signal._Smax).decompose())) * u_scale
+        )
+
+    def _pow_noise_norm(self, signal, Tsys, gain, pulsar):
+        """Intensity-signal noise scale (reference: receiver.py:140-172)."""
+        nbins = signal.nsamp / signal.nsub  # bins per subint
+        dt = signal.sublen / nbins
+        bw_per_chan = signal.bw / signal.Nchan
+        sigS = Tsys / gain / np.sqrt(2 * dt * bw_per_chan)
+        df = signal.Nfold if signal.fold else 1
+        u_scale = 1.0 / (float(np.sum(pulsar.Profiles._max_profile)) / nbins)
+        norm = (
+            float(((sigS * signal._draw_norm) / signal._Smax).decompose()) * u_scale
+        )
+        return norm, float(df)
+
+    def _add_amp_noise(self, signal, Tsys, gain, pulsar):
+        norm = self._amp_noise_norm(signal, Tsys, gain, pulsar)
+        signal.data = _add_amp_noise_kernel(
+            self._keys.next("noise"), signal.data, jnp.float32(norm)
+        )
+
+    def _add_pow_noise(self, signal, Tsys, gain, pulsar):
+        norm, df = self._pow_noise_norm(signal, Tsys, gain, pulsar)
+        signal.data = _add_pow_noise_kernel(
+            self._keys.next("noise"), signal.data, jnp.float32(df), jnp.float32(norm)
+        )
+
+
+def response_from_data(fs, values):
+    """Generate a callable response function from discrete data (stub in the
+    reference, receiver.py:176-180)."""
+    raise NotImplementedError()
+
+
+def _flat_response(fcent, bandwidth):
+    """Flat (heaviside-edged) bandpass callable
+    (reference: receiver.py:182-197)."""
+    fc = make_quant(fcent, "MHz")
+    bw = make_quant(bandwidth, "MHz")
+    fmin = fc - bw / 2
+    fmax = fc + bw / 2
+
+    def bandpass(f):
+        f = make_quant(f, "MHz")
+        return np.heaviside((f - fmin).to("MHz").value, 0) * np.heaviside(
+            (fmax - f).to("MHz").value, 0
+        )
+
+    return bandpass

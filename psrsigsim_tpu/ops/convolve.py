@@ -41,7 +41,8 @@ def convolve_profiles(profiles, kernels, width):
     """
     psum = profiles.sum(axis=-1, keepdims=True)
     ksum = kernels.sum(axis=-1, keepdims=True)
-    pnorm = jnp.where(psum != 0.0, profiles / jnp.where(psum == 0.0, 1.0, psum), profiles)
-    knorm = jnp.where(ksum != 0.0, kernels / jnp.where(ksum == 0.0, 1.0, ksum), kernels)
+    # sum-normalize with a zero-sum guard (divide by 1 leaves row as-is)
+    pnorm = profiles / jnp.where(psum == 0.0, 1.0, psum)
+    knorm = kernels / jnp.where(ksum == 0.0, 1.0, ksum)
     conv = fft_convolve_full(pnorm, knorm)[..., :width]
     return psum * conv

@@ -1,0 +1,13 @@
+"""Reference-parity import alias: ``psrsigsim_tpu.telescope`` mirrors
+``psrsigsim.telescope``."""
+
+from ..models.telescope import (
+    Arecibo,
+    Backend,
+    GBT,
+    Receiver,
+    Telescope,
+    response_from_data,
+)
+
+__all__ = ["Telescope", "Receiver", "response_from_data", "Backend", "GBT", "Arecibo"]

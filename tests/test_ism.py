@@ -1,0 +1,160 @@
+"""Tests for ISM propagation (mirrors reference tests/test_ism.py scope,
+plus numerical checks the reference lacks)."""
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.ism import ISM
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.signal import BasebandSignal, FilterBankSignal
+from psrsigsim_tpu.utils import DM_K_MS_MHZ2
+
+
+@pytest.fixture
+def made_signal():
+    sig = FilterBankSignal(1400, 400, Nsubband=8, sublen=0.25, fold=True)
+    psr = Pulsar(0.005, 1.0, GaussProfile(width=0.02), seed=21)
+    psr.make_pulses(sig, tobs=1.0)
+    return sig, psr
+
+
+class TestDisperse:
+    def test_delay_accumulation_and_flag(self, made_signal):
+        sig, _ = made_signal
+        ism = ISM()
+        ism.disperse(sig, 10.0)
+        assert sig.dm.value == 10.0
+        expect = DM_K_MS_MHZ2 * 10.0 / sig.dat_freq.value**2
+        np.testing.assert_allclose(sig.delay.to("ms").value, expect, rtol=1e-10)
+
+    def test_double_disperse_raises(self, made_signal):
+        sig, _ = made_signal
+        ism = ISM()
+        ism.disperse(sig, 10.0)
+        with pytest.raises(ValueError):
+            ism.disperse(sig, 10.0)
+
+    def test_peaks_shift_by_predicted_bins(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=8, sublen=0.25, fold=True)
+        psr = Pulsar(0.005, 1.0, GaussProfile(width=0.01), seed=22)
+        psr.make_pulses(sig, tobs=0.25)  # single subint, clean profile
+        nph = int((sig.samprate * psr.period).decompose())
+        before = np.asarray(sig.data)
+        ISM().disperse(sig, 2.0)
+        after = np.asarray(sig.data)
+        dt_ms = float((1 / sig.samprate).to("ms").value)
+        for ch in (0, 4, 7):
+            delay_bins = int(round(sig.delay.to("ms").value[ch] / dt_ms))
+            peak_before = before[ch].argmax()
+            peak_after = after[ch].argmax()
+            assert (peak_after - peak_before) % before.shape[1] == pytest.approx(
+                delay_bins % before.shape[1], abs=1
+            )
+
+    def test_disperse_then_fd_accumulates(self, made_signal):
+        sig, _ = made_signal
+        ism = ISM()
+        ism.disperse(sig, 10.0)
+        d1 = sig.delay.to("ms").value.copy()
+        ism.FD_shift(sig, [2e-5])
+        d2 = sig.delay.to("ms").value
+        assert not np.allclose(d1, d2)
+        assert sig._FDshifted
+
+    def test_baseband_coherent_dispersion(self):
+        sig = BasebandSignal(1400, 100, Nchan=2)
+        psr = Pulsar(0.005, 1.0, GaussProfile(width=0.02), seed=23)
+        psr.make_pulses(sig, tobs=0.005)  # one full period so the pulse lands
+        before = np.asarray(sig.data).copy()
+        ISM().disperse(sig, 3.0)
+        after = np.asarray(sig.data)
+        assert after.shape == before.shape
+        assert not np.allclose(after, before)
+        # unitary transfer: total power preserved to float32 tolerance
+        assert np.sum(after**2) == pytest.approx(np.sum(before**2), rel=2e-2)
+
+
+class TestFDShift:
+    def test_fd_delay_polynomial(self, made_signal):
+        sig, _ = made_signal
+        ism = ISM()
+        c1, c2 = 2e-4, -1e-4
+        ism.FD_shift(sig, [c1, c2])
+        logf = np.log(sig.dat_freq.value / 1000.0)
+        expect_ms = (c1 * 1e3) * logf + (c2 * 1e3) * logf**2
+        np.testing.assert_allclose(sig.delay.to("ms").value, expect_ms, rtol=1e-6)
+
+
+class TestScatterBroaden:
+    def test_shift_mode_accumulates_scaled_delays(self, made_signal):
+        sig, psr = made_signal
+        ism = ISM()
+        tau_d = 5e-5
+        ism.scatter_broaden(sig, tau_d, 1400.0)
+        delays = sig.delay.to("ms").value
+        # tau scales as (f/fref)^(-4.4): low channels delayed more
+        assert delays[0] > delays[-1]
+        ratio = delays[0] / delays[-1]
+        f = sig.dat_freq.value
+        assert ratio == pytest.approx((f[0] / f[-1]) ** (-2 * (11 / 3) / (11 / 3 - 2)),
+                                      rel=1e-5)
+
+    def test_convolve_mode_broadens_profiles(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=4, sublen=0.25, fold=True)
+        psr = Pulsar(0.005, 1.0, GaussProfile(width=0.01), seed=24)
+        ism = ISM()
+        # BEFORE make_pulses, per the reference's contract
+        ism.scatter_broaden(sig, 1e-4, 1400.0, convolve=True, pulsar=psr)
+        from psrsigsim_tpu.pulsar import DataPortrait
+
+        assert isinstance(psr.Profiles, DataPortrait)
+        psr.make_pulses(sig, tobs=0.5)
+        # scattered profile has an exponential tail: rising edge steeper than
+        # falling edge
+        prof = psr.Profiles._max_profile
+        peak = prof.argmax()
+        assert prof[(peak + 10) % len(prof)] > prof[(peak - 10) % len(prof)]
+
+    def test_convolve_profile_flux_preserved(self):
+        ism = ISM()
+        nph = 256
+        ph = np.arange(nph) / nph
+        profs = np.exp(-0.5 * ((ph - 0.5) / 0.02) ** 2)[None, :].repeat(3, axis=0)
+        tails = np.exp(-ph / 0.05)[None, :].repeat(3, axis=0)
+        out = ism.convolve_profile(profs.copy(), tails, width=nph)
+        # sum-normalized convolution rescaled by the profile sum: total flux
+        # approx preserved (up to tail truncation)
+        assert out.sum() == pytest.approx(profs.sum(), rel=0.1)
+
+
+class TestScalingLaws:
+    def test_kolmogorov_values(self):
+        ism = ISM()
+        # beta = 11/3: dnu ~ nu^4.4, dt ~ nu^1.2, tau ~ nu^-4.4
+        assert ism.scale_dnu_d(1.0, 1000.0, 2000.0) == pytest.approx(2**4.4)
+        assert ism.scale_dt_d(1.0, 1000.0, 2000.0) == pytest.approx(2**1.2)
+        assert ism.scale_tau_d(1.0, 1000.0, 2000.0) == pytest.approx(2**-4.4)
+
+    def test_thick_screen_branch(self):
+        ism = ISM()
+        beta = 4.4
+        assert ism.scale_dnu_d(1.0, 1000.0, 2000.0, beta=beta) == pytest.approx(
+            2 ** (8.0 / (6 - beta))
+        )
+        assert ism.scale_dt_d(1.0, 1000.0, 2000.0, beta=beta) == pytest.approx(
+            2 ** ((beta - 2) / (6 - beta))
+        )
+        assert ism.scale_tau_d(1.0, 1000.0, 2000.0, beta=beta) == pytest.approx(
+            2 ** (-8.0 / (6 - beta))
+        )
+
+    def test_beta_four_rejected(self):
+        with pytest.raises(ValueError):
+            ISM().scale_tau_d(1.0, 1000.0, 2000.0, beta=4.0)
+
+    def test_array_frequency_scaling(self):
+        ism = ISM()
+        freqs = np.array([500.0, 1000.0, 2000.0])
+        out = ism.scale_tau_d(1.0, 1000.0, freqs)
+        assert out[1] == pytest.approx(1.0)
+        assert out[0] > out[1] > out[2]

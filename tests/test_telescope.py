@@ -1,0 +1,199 @@
+"""Tests for Telescope/Receiver/Backend (mirrors reference
+tests/test_telescope.py scope, plus radiometer-noise moment checks)."""
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.signal import BasebandSignal, FilterBankSignal
+from psrsigsim_tpu.telescope import Arecibo, Backend, GBT, Receiver, Telescope
+from psrsigsim_tpu.telescope import response_from_data
+
+
+@pytest.fixture
+def observed():
+    sig = FilterBankSignal(1400, 400, Nsubband=8, sublen=0.25, fold=True)
+    psr = Pulsar(0.005, 0.01, GaussProfile(width=0.02), seed=31)
+    psr.make_pulses(sig, tobs=1.0)
+    return sig, psr
+
+
+class TestReceiver:
+    def test_ctor_flat_response(self):
+        r = Receiver(fcent=1400, bandwidth=400, name="Lband")
+        assert r.fcent.value == 1400
+        assert r.bandwidth.value == 400
+        assert r.Trec.value == 35
+        assert repr(r) == "Receiver(Lband)"
+        # flat bandpass: inside 1, outside 0
+        assert r.response(1400.0) == 1.0
+        assert r.response(1000.0) == 0.0
+
+    def test_ctor_requires_fcent_and_bw(self):
+        with pytest.raises(ValueError):
+            Receiver()
+        with pytest.raises(ValueError):
+            Receiver(fcent=1400)
+        with pytest.raises(ValueError):
+            Receiver(bandwidth=400)
+
+    def test_callable_response_not_implemented(self):
+        with pytest.raises((NotImplementedError, ValueError)):
+            Receiver(response=lambda f: np.ones_like(f))
+
+    def test_response_xor_fcent(self):
+        with pytest.raises(ValueError):
+            Receiver(response=lambda f: f, fcent=1400, bandwidth=400)
+
+    def test_tsys_tenv_exclusive(self, observed):
+        sig, psr = observed
+        r = Receiver(fcent=1400, bandwidth=400, seed=1)
+        with pytest.raises(ValueError):
+            r.radiometer_noise(sig, psr, Tsys=30.0, Tenv=5.0)
+
+    def test_tenv_adds_trec(self, observed):
+        sig, psr = observed
+        r = Receiver(fcent=1400, bandwidth=400, Trec=30, seed=1)
+        tsys = r._resolve_tsys(None, 10.0)
+        assert tsys.to("K").value == pytest.approx(40.0)
+
+    def test_pow_noise_statistics(self, observed):
+        # noise std in off-pulse regions should follow the radiometer formula
+        sig, psr = observed
+        r = Receiver(fcent=1400, bandwidth=400, seed=2)
+        norm, df = r._pow_noise_norm(
+            sig, r._resolve_tsys(None, None), __import__(
+                "psrsigsim_tpu.utils", fromlist=["make_quant"]
+            ).make_quant(2.0, "K/Jy"), psr
+        )
+        before = np.asarray(sig.data).copy()
+        r.radiometer_noise(sig, psr, gain=2.0)
+        after = np.asarray(sig.data)
+        delta = after - before
+        # added noise is chi2(df)*norm: mean df*norm, var 2*df*norm^2
+        assert delta.mean() == pytest.approx(df * norm, rel=0.05)
+        assert delta.var() == pytest.approx(2 * df * norm**2, rel=0.1)
+
+    def test_amp_noise_on_baseband(self):
+        sig = BasebandSignal(1400, 100, Nchan=2)
+        psr = Pulsar(0.005, 0.01, GaussProfile(width=0.02), seed=32)
+        psr.make_pulses(sig, tobs=0.005)
+        r = Receiver(fcent=1400, bandwidth=100, seed=3)
+        before = np.asarray(sig.data).copy()
+        r.radiometer_noise(sig, psr, gain=2.0)
+        delta = np.asarray(sig.data) - before
+        assert abs(delta.mean()) < 0.05 * delta.std()  # zero-mean gaussian
+
+    def test_response_from_data_stub(self):
+        with pytest.raises(NotImplementedError):
+            response_from_data(np.arange(4.0), np.ones(4))
+
+
+class TestBackend:
+    def test_ctor(self):
+        b = Backend(samprate=12.5, name="GUPPI")
+        assert b.samprate.to("MHz").value == 12.5
+        assert repr(b) == "Backend(GUPPI)"
+
+    def test_adc_noop(self, observed):
+        sig, _ = observed
+        assert Backend(samprate=1.0, name="x").adc(sig) is None
+
+    def test_fold_sums_periods(self, observed):
+        sig, psr = observed
+        b = Backend(samprate=12.5, name="GUPPI")
+        folded = np.asarray(b.fold(sig, psr))
+        nph = int((psr.period * sig.samprate).decompose())
+        nfold = sig.data.shape[1] // nph
+        assert folded.shape == (8, nph)
+        expect = np.asarray(sig.data)[:, : nfold * nph].reshape(8, nfold, nph).sum(1)
+        np.testing.assert_allclose(folded, expect, rtol=1e-5)
+
+
+class TestTelescope:
+    def test_gain_formula(self):
+        t = Telescope(100.0, area=5500.0, Tsys=35.0, name="GBT")
+        assert t.gain.to("K/Jy").value == pytest.approx(
+            5500.0 / (2 * 1.38064852e3)
+        )
+
+    def test_circular_dish_default_area(self):
+        t = Telescope(100.0, name="dish")
+        assert t.area.to("m^2").value == pytest.approx(np.pi * 50.0**2)
+        assert t.Tsys is None
+
+    def test_add_system(self):
+        t = Telescope(100.0, area=5500.0, Tsys=35.0, name="GBT")
+        r, b = Receiver(fcent=1400, bandwidth=400), Backend(samprate=12.5)
+        t.add_system("sys", r, b)
+        assert t.systems["sys"] == (r, b)
+
+    def test_gbt_systems(self):
+        g = GBT()
+        assert set(g.systems) == {"820_GUPPI", "Lband_GUPPI", "800_GASP",
+                                  "Lband_GASP"}
+        assert g.name == "GBT"
+        assert g.Tsys.value == 35.0
+
+    def test_arecibo_systems(self):
+        a = Arecibo()
+        assert set(a.systems) == {
+            "430_PUPPI", "Lband_PUPPI", "Sband_PUPPI",
+            "327_ASP", "430_ASP", "Lband_ASP", "Sband_ASP",
+        }
+
+    def test_observe_adds_noise_in_place(self, observed):
+        sig, psr = observed
+        g = GBT()
+        before = np.asarray(sig.data).copy()
+        g.observe(sig, psr, system="Lband_GUPPI", noise=True)
+        after = np.asarray(sig.data)
+        assert not np.array_equal(before, after)
+        assert after.shape == before.shape  # resample NOT written back
+
+    def test_observe_returns_resamp_only_on_request(self, observed):
+        sig, psr = observed
+        g = GBT()
+        assert g.observe(sig, psr, system="Lband_GUPPI", noise=False) is None
+        out = g.observe(sig, psr, system="Lband_GUPPI", noise=False,
+                        ret_resampsig=True)
+        assert out is not None
+        assert out.dtype == sig.dtype
+
+    def test_observe_clips_at_draw_max(self, observed):
+        sig, psr = observed
+        import jax.numpy as jnp
+
+        sig.data = sig.data.at[0, 0].set(1e6)
+        g = GBT()
+        out = g.observe(sig, psr, system="Lband_GUPPI", noise=False,
+                        ret_resampsig=True)
+        assert out.max() <= sig._draw_max
+
+    def test_observe_baseband_not_implemented(self):
+        sig = BasebandSignal(1400, 100)
+        psr = Pulsar(0.005, 0.01, GaussProfile(), seed=33)
+        with pytest.raises(NotImplementedError):
+            GBT().observe(sig, psr, system="Lband_GUPPI")
+
+    def test_observe_downsample_branch(self, capsys):
+        # engineer dt_tel an integer multiple of dt_sig
+        sig = FilterBankSignal(1400, 400, Nsubband=2, sample_rate=1.0,
+                               fold=False)
+        psr = Pulsar(0.005, 0.01, GaussProfile(width=0.02), seed=34)
+        psr.make_pulses(sig, tobs=0.05)
+        t = Telescope(100.0, area=5500.0, Tsys=35.0, name="T")
+        t.add_system("s", Receiver(fcent=1400, bandwidth=400, seed=4),
+                     Backend(samprate=0.25))  # dt_tel = 2 us = 2 * dt_sig
+        out = t.observe(sig, psr, system="s", noise=False, ret_resampsig=True)
+        assert out.shape[1] == sig.data.shape[1] // 2
+        assert "samp freq" in capsys.readouterr().out
+
+    def test_observe_stub_methods(self):
+        t = Telescope(100.0, name="x")
+        with pytest.raises(NotImplementedError):
+            t.apply_response(None)
+        with pytest.raises(NotImplementedError):
+            t.rfi()
+        with pytest.raises(NotImplementedError):
+            t.init_signal("s")
