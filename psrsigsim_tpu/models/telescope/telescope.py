@@ -107,31 +107,32 @@ class Telescope:
         if dt_sig != dt_tel and (dt_tel % dt_sig == 0 or dt_tel > dt_sig):
             print(rate_msg)
 
+        # resample from the PRE-noise buffer, as the reference does
+        # (telescope.py:93-127 builds `out` before the noise block); skipped
+        # entirely when the caller discards it — the reference computes and
+        # throws it away (DIVERGENCES.md #7)
+        out = None
+        if ret_resampsig:
+            sig_in = signal.data
+            if dt_sig == dt_tel:
+                out = sig_in
+            elif dt_tel % dt_sig == 0:
+                out = block_downsample(sig_in, int(dt_tel // dt_sig))
+            elif dt_tel > dt_sig:
+                new_nt = int(float(signal.tobs.to("s").value) // dt_tel)
+                out = rebin(sig_in, new_nt)
+            else:
+                # sub-rate signal: pass through (reference: telescope.py:123-126)
+                out = sig_in
+
         if noise:
             # in-place on the signal at its native rate (reference quirk,
             # DIVERGENCES.md #7)
             rcvr.radiometer_noise(signal, pulsar, gain=self.gain, Tsys=self.Tsys)
 
-        if not ret_resampsig:
-            # the reference computes-and-discards the resampled product here
-            # (telescope.py:102-145); skipping the dead work (and the
-            # device->host copy) is observably identical
-            return None
-
-        sig_in = signal.data
-        if dt_sig == dt_tel:
-            out = sig_in
-        elif dt_tel % dt_sig == 0:
-            out = block_downsample(sig_in, int(dt_tel // dt_sig))
-        elif dt_tel > dt_sig:
-            new_nt = int(float(signal.tobs.to("s").value) // dt_tel)
-            out = rebin(sig_in, new_nt)
-        else:
-            # sub-rate signal: pass through (reference: telescope.py:123-126)
-            out = sig_in
-
-        out = _clip_upper(out, jnp.float32(signal._draw_max))
-        return np.asarray(out).astype(signal.dtype)
+        if ret_resampsig:
+            out = _clip_upper(out, jnp.float32(signal._draw_max))
+            return np.asarray(out).astype(signal.dtype)
 
     def apply_response(self, signal):
         raise NotImplementedError()

@@ -1,0 +1,24 @@
+"""Mesh sharding and ensemble parallelism (TPU-native; the reference has no
+parallel layer — SURVEY.md §2.1)."""
+
+from .ensemble import FoldEnsemble
+from .mesh import (
+    CHAN_AXIS,
+    OBS_AXIS,
+    batch_sharding,
+    distributed_init,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "FoldEnsemble",
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "distributed_init",
+    "OBS_AXIS",
+    "CHAN_AXIS",
+]

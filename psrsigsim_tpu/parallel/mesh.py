@@ -1,0 +1,95 @@
+"""Device mesh and sharding helpers.
+
+The reference has no parallelism of any kind (SURVEY.md §2.1); the structural
+parallelism of this workload is (1) independent observations (data/ensemble
+axis) and (2) independent frequency channels.  Both map onto a 2-D
+``jax.sharding.Mesh`` with axes ``("obs", "chan")``: per-channel FFTs stay
+device-local (no collectives in the pipeline), so sharding either axis scales
+linearly over ICI.  Cross-device communication appears only at reductions
+(profile normalization max, Smax sums — handled host-side at config time) and
+at IO gather.
+
+Multi-host: :func:`distributed_init` wraps ``jax.distributed.initialize`` —
+the XLA-collectives-over-ICI/DCN analog of the reference's (absent) NCCL/MPI
+backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "distributed_init",
+    "OBS_AXIS",
+    "CHAN_AXIS",
+]
+
+OBS_AXIS = "obs"
+CHAN_AXIS = "chan"
+
+
+def make_mesh(shape=None, devices=None):
+    """Build an ``(obs, chan)`` mesh over the available devices.
+
+    Args:
+        shape: ``(n_obs_shards, n_chan_shards)``; default puts every device
+            on the observation axis — the right default for Monte-Carlo
+            ensembles, which are embarrassingly parallel.
+        devices: explicit device list (default ``jax.devices()``).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if shape is None:
+        shape = (len(devices), 1)
+    if shape[0] * shape[1] != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} does not tile {len(devices)} devices"
+        )
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, (OBS_AXIS, CHAN_AXIS))
+
+
+def batch_sharding(mesh, batch_ndim=1):
+    """Sharding for ``(B, Nchan, Nsamp)`` ensemble blocks: observations over
+    the obs axis, channels over the chan axis, time local."""
+    spec = [OBS_AXIS] + [None] * (batch_ndim - 1) + [CHAN_AXIS, None]
+    return NamedSharding(mesh, PartitionSpec(*spec[: batch_ndim + 2]))
+
+
+def replicated_sharding(mesh):
+    """Fully-replicated sharding (for shared profiles/configs)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(arr, mesh):
+    """Place a host batch array onto the mesh, leading axis over ``obs``."""
+    ndim = np.ndim(arr)
+    if ndim == 0:
+        return jax.device_put(arr, replicated_sharding(mesh))
+    spec = [OBS_AXIS] + [None] * (ndim - 1)
+    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def distributed_init(coordinator_address=None, num_processes=None,
+                     process_id=None, **kw):
+    """Initialize multi-host JAX (ICI within a slice, DCN across slices).
+
+    Thin wrapper over ``jax.distributed.initialize`` so multi-host runs are a
+    one-call setup; on single-host (or if already initialized) it is a no-op.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kw,
+        )
+    except (RuntimeError, ValueError) as err:  # already initialized / 1-proc
+        if "already" not in str(err).lower() and num_processes not in (None, 1):
+            raise

@@ -1,0 +1,18 @@
+"""Orchestration: the Simulation façade + the jitted functional pipeline
+(reference layer: psrsigsim/simulate/)."""
+
+from .pipeline import (
+    FoldPipelineConfig,
+    build_fold_config,
+    fold_pipeline,
+    fold_pipeline_batch,
+)
+from .simulate import Simulation
+
+__all__ = [
+    "Simulation",
+    "fold_pipeline",
+    "fold_pipeline_batch",
+    "build_fold_config",
+    "FoldPipelineConfig",
+]

@@ -1,0 +1,185 @@
+"""The end-to-end observation pipeline as ONE jitted XLA program.
+
+This is the TPU-first heart of the framework (SURVEY.md §7 step 6): the
+reference's call chain ``make_pulses -> disperse -> observe(noise)``
+(simulate/simulate.py:292-326) expressed as a pure function
+
+    fold_pipeline(key, dm, noise_norm, profiles, cfg) -> (Nchan, Nsamp)
+
+with all shapes fixed by a hashable static config.  vmap it over
+``(key, dm, noise_norm[, profiles])`` for Monte-Carlo ensembles; shard the
+batch axis over a mesh with :mod:`psrsigsim_tpu.parallel`.
+
+Everything random threads explicit stage keys, so results are independent of
+batch order and mesh layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.shift import fourier_shift
+from ..ops.stats import chi2_sample
+from ..signal.state import SignalMeta
+from ..utils.constants import DM_K_MS_MHZ2
+from ..utils.rng import stage_key
+
+__all__ = [
+    "FoldPipelineConfig",
+    "fold_pipeline",
+    "fold_pipeline_batch",
+    "build_fold_config",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldPipelineConfig:
+    """Static (trace-time) configuration of a fold-mode observation."""
+
+    meta: SignalMeta
+    period_s: float
+    nsub: int
+    nph: int
+    nfold: float  # chi2 df of the pulse intensity draws (sublen/period)
+    draw_norm: float  # dynamic-range scaling (int8) — fb_signal.py:114-121
+    noise_df: float  # chi2 df of the radiometer noise draws
+    dt_ms: float  # sample spacing, ms
+    clip_max: float  # draw ceiling for the EXPORT path (telescope.py:141-144);
+    # NOT applied to live signal data — the reference clips only the
+    # resampled product it returns, never the signal buffer
+
+    @property
+    def nsamp(self):
+        return self.nsub * self.nph
+
+
+def _freqs_mhz(cfg):
+    return jnp.asarray(cfg.meta.dat_freq_mhz(), dtype=jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None):
+    """One fold-mode observation: synthesis + dispersion + radiometer noise.
+
+    Args:
+        key: observation PRNG key.
+        dm: dispersion measure (traced; pc/cm^3).
+        noise_norm: radiometer noise scale (traced; from
+            :meth:`Receiver._pow_noise_norm` semantics).
+        profiles: normalized portrait ``(Nchan, Nph)``; under channel
+            sharding, the local shard.
+        cfg: static :class:`FoldPipelineConfig`.
+        freqs: channel frequencies (MHz) matching ``profiles``' channel axis;
+            defaults to the full grid from ``cfg``.  Pass the local slice
+            when calling inside shard_map.
+        chan_ids: GLOBAL channel indices matching ``profiles``' channel axis.
+            All random draws are keyed by (observation key, stage, global
+            channel), so results are bit-identical for any mesh shape or
+            channel-shard split.
+
+    Returns:
+        ``(Nchan, nsub*Nph)`` float32 block (unclipped — clipping belongs to
+        the export path, see ``clip_max``).
+    """
+    kp = stage_key(key, "pulse")
+    kn = stage_key(key, "noise")
+    if freqs is None:
+        freqs = _freqs_mhz(cfg)
+    if chan_ids is None:
+        chan_ids = jnp.arange(freqs.shape[0])
+
+    nsamp = cfg.nsub * cfg.nph
+    chan_draw = jax.vmap(
+        lambda k, c: chi2_sample(jax.random.fold_in(k, c), cfg.nfold, (nsamp,)),
+        in_axes=(None, 0),
+    )
+    chan_noise = jax.vmap(
+        lambda k, c: chi2_sample(jax.random.fold_in(k, c), cfg.noise_df, (nsamp,)),
+        in_axes=(None, 0),
+    )
+
+    # pulse synthesis (reference: pulsar.py:196-221)
+    block = jnp.tile(profiles, (1, cfg.nsub))
+    block = block * chan_draw(kp, chan_ids) * cfg.draw_norm
+
+    # dispersion (reference: ism/ism.py:40-74), delays from the traced DM
+    delays_ms = DM_K_MS_MHZ2 * dm / freqs**2
+    block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
+
+    # radiometer noise (reference: receiver.py:140-172)
+    return block + chan_noise(kn, chan_ids) * noise_norm
+
+
+def fold_pipeline_batch(cfg, shared_profiles=True):
+    """vmapped ensemble version: ``(B,) keys, (B,) dms, (B,) noise_norms``
+    (+ optionally ``(B, Nchan, Nph)`` profiles) -> ``(B, Nchan, Nsamp)``."""
+    in_axes = (0, 0, 0, None if shared_profiles else 0)
+    batched = jax.vmap(
+        lambda k, d, n, p: fold_pipeline(k, d, n, p, cfg), in_axes=in_axes
+    )
+    return batched
+
+
+def build_fold_config(signal, pulsar, telescope, system, Tsys=None):
+    """Derive the static config + host inputs for the functional pipeline
+    from configured OO objects (without generating any data).
+
+    Returns ``(cfg, profiles_np, noise_norm)``: feed ``profiles_np`` and a
+    per-observation ``noise_norm`` (scale with Smean if it varies) into
+    :func:`fold_pipeline`.
+    """
+    if not signal.fold:
+        raise ValueError("build_fold_config requires a fold-mode FilterBankSignal")
+
+    period_s = float(pulsar.period.to("s").value)
+    nph = int((signal.samprate * pulsar.period).decompose())
+    tobs = signal.tobs
+    if tobs is None:
+        raise ValueError("set signal._tobs (or pass tobs through Simulation) first")
+    if signal.sublen is None:
+        nsub = 1
+        sublen_s = float(tobs.to("s").value)
+    else:
+        sublen_s = float(signal.sublen.to("s").value)
+        nsub = int(np.round(float((tobs / signal.sublen).decompose())))
+    nfold = sublen_s / period_s
+
+    # profile normalization + Smax on host (reference: pulsar.py:124-151)
+    if pulsar.ref_freq is None:
+        pulsar._ref_freq = signal.fcent
+    if signal.sigtype == "FilterBankSignal" and pulsar.specidx != 0.0:
+        pulsar._add_spec_idx(signal)
+    pulsar.Profiles.init_profiles(nph, signal.Nchan)
+    profiles_np = np.asarray(pulsar.Profiles.profiles, dtype=np.float32)
+    pr = pulsar.Profiles._max_profile
+    signal._Smax = pulsar.Smean * len(pr) / float(np.sum(pr))
+
+    # mirror the signal bookkeeping make_pulses would do
+    signal._nsub = nsub
+    signal._nsamp = int(nsub * period_s * float(signal.samprate.to("MHz").value) * 1e6)
+    signal._Nfold = nfold
+    signal._set_draw_norm(df=nfold)
+    if signal.sublen is None:
+        signal._sublen = tobs
+
+    rcvr, _ = telescope.systems[system]
+    tsys = rcvr._resolve_tsys(Tsys if Tsys is not None else telescope.Tsys, None)
+    noise_norm, noise_df = rcvr._pow_noise_norm(signal, tsys, telescope.gain, pulsar)
+
+    cfg = FoldPipelineConfig(
+        meta=signal.meta(),
+        period_s=period_s,
+        nsub=nsub,
+        nph=nph,
+        nfold=float(nfold),
+        draw_norm=float(signal._draw_norm),
+        noise_df=float(noise_df),
+        dt_ms=float((1 / signal.samprate).to("ms").value),
+        clip_max=float(signal._draw_max),
+    )
+    return cfg, profiles_np, float(noise_norm)

@@ -197,3 +197,20 @@ class TestTelescope:
             t.rfi()
         with pytest.raises(NotImplementedError):
             t.init_signal("s")
+
+
+class TestObserveNoiseOrdering:
+    def test_resampled_product_is_pre_noise(self):
+        """Reference builds the resampled product BEFORE adding noise; the
+        returned array must not contain the radiometer noise."""
+        sig = FilterBankSignal(1400, 400, Nsubband=8, sublen=0.25, fold=True)
+        psr = Pulsar(0.005, 0.01, GaussProfile(width=0.02), seed=77)
+        psr.make_pulses(sig, tobs=1.0)
+        pre_noise = np.asarray(sig.data).copy()
+        g = GBT()
+        out = g.observe(sig, psr, system="Lband_GUPPI", noise=True,
+                        ret_resampsig=True)
+        post_noise = np.asarray(sig.data)
+        assert not np.array_equal(pre_noise, post_noise)  # noise was added
+        expect = np.minimum(pre_noise, sig._draw_max).astype(sig.dtype)
+        np.testing.assert_allclose(out, expect, atol=1e-5)
