@@ -1,0 +1,21 @@
+"""IO: PSRFITS / pdv data products (reference layer: psrsigsim/io/), backed
+by a from-scratch FITS core and closed-form polycos (no cfitsio/PINT)."""
+
+from .file import BaseFile
+from .fits import Card, FitsFile, HDU, Header
+from .polyco import generate_polyco, parse_par, polyco_phase
+from .psrfits import PSRFITS
+from .txtfile import TxtFile
+
+__all__ = [
+    "BaseFile",
+    "PSRFITS",
+    "TxtFile",
+    "FitsFile",
+    "HDU",
+    "Header",
+    "Card",
+    "generate_polyco",
+    "parse_par",
+    "polyco_phase",
+]

@@ -1,0 +1,120 @@
+"""Polyco generation for PSRFITS phase connection — PINT replacement.
+
+The reference delegates to ``pint.polycos`` with a TEMPO-style fit
+(reference: io/psrfits.py:116-181).  PINT is unavailable here, and for the
+signals this framework simulates the timing model is an isolated spin model
+(the generated par files carry F0/DM and fixed defaults with TZRSITE='@',
+utils/utils.py:350-395), so the polyco is computed in closed form instead of
+fit: for phase
+
+    phi(t) = F0 * dt_s + F1/2 * dt_s^2,   dt_s = (t - PEPOCH) * 86400
+
+the TEMPO polyco convention
+
+    phi(t) = RPHASE + COEFF1 + 60*F0_ref*dt_min + COEFF2*dt_min + ...
+
+is satisfied exactly by Taylor expansion about the segment midpoint — no
+node fitting, no fit residuals.  For barycentric/observatory-corrected
+models, feed polycos from an external tool instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["parse_par", "generate_polyco", "polyco_phase"]
+
+
+def parse_par(parfile):
+    """Parse a TEMPO/PINT-style .par file into a dict of strings/floats.
+
+    Handles the subset the framework writes and reads: flag-style values stay
+    strings; numeric values become float (with Fortran 'D' exponents).
+    """
+    params = {}
+    with open(parfile) as f:
+        for line in f:
+            parts = line.split()
+            if not parts or parts[0].startswith("#"):
+                continue
+            key = parts[0]
+            if len(parts) == 1:
+                params[key] = ""
+                continue
+            val = parts[1]
+            try:
+                params[key] = float(val.replace("D", "E").replace("d", "e"))
+            except ValueError:
+                params[key] = val
+    return params
+
+
+def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15):
+    """Closed-form polyco for an isolated spin model (F0 [, F1]).
+
+    Args:
+        parfile: path to the .par file (needs F0; optional F1, PEPOCH,
+            TZRFRQ, TZRSITE, TZRMJD).
+        MJD_start: start MJD of the span.
+        segLength: span length in minutes (NSPAN).
+        ncoeff: number of coefficients (NCOEF); extras are zero.
+
+    Returns:
+        dict with the keys the PSRFITS POLYCO table wants: NSPAN, NCOEF,
+        REF_FREQ, NSITE, REF_F0, COEFF, REF_MJD, REF_PHS — mirroring the
+        reference's polyco_dict (io/psrfits.py:144-177).
+    """
+    m = parse_par(parfile)
+    if "F0" in m:
+        f0 = float(m["F0"])
+    elif "F" in m:
+        f0 = float(m["F"])
+    else:
+        raise ValueError(f"par file {parfile} has no F0")
+    f1 = float(m.get("F1", 0.0))
+    pepoch = float(m.get("PEPOCH", 56000.0))
+    ref_freq = float(m.get("TZRFRQ", 1500.0))
+    nsite = str(m.get("TZRSITE", "@"))
+
+    seg_days = segLength / 1440.0
+    tmid = MJD_start + seg_days / 2.0
+
+    # absolute phase at tmid for phi(t) = F0*dt + F1/2*dt^2 (dt in s from
+    # PEPOCH)
+    dt_s = (tmid - pepoch) * 86400.0
+    phase_mid = f0 * dt_s + 0.5 * f1 * dt_s**2
+    freq_mid = f0 + f1 * dt_s  # apparent spin frequency at tmid
+
+    # TEMPO convention: phi(t) = RPHASE + COEFF[0] + 60*REF_F0*dt_min
+    #                           + COEFF[1]*dt_min + COEFF[2]*dt_min^2 + ...
+    # with REF_F0 reported as F0.  Taylor about tmid:
+    #   phi = phase_mid + freq_mid*60*dt_min + (F1/2)*3600*dt_min^2
+    # so COEFF[1] absorbs the (freq_mid - F0) drift term.
+    coeffs = np.zeros(ncoeff, dtype=np.float64)
+    coeffs[0] = 0.0
+    if ncoeff > 1:
+        coeffs[1] = (freq_mid - f0) * 60.0
+    if ncoeff > 2:
+        coeffs[2] = 0.5 * f1 * 3600.0
+
+    ref_phs = phase_mid - np.floor(phase_mid)  # fractional, always positive
+
+    return {
+        "NSPAN": segLength,
+        "NCOEF": ncoeff,
+        "REF_FREQ": ref_freq,
+        "NSITE": nsite.encode("utf-8"),
+        "REF_F0": f0,
+        "COEFF": coeffs,
+        "REF_MJD": np.double(tmid),
+        "REF_PHS": np.double(ref_phs),
+    }
+
+
+def polyco_phase(polyco, mjd):
+    """Evaluate a polyco dict at an MJD (cycles relative to REF_PHS) —
+    used for self-consistency tests and by downstream folding tools."""
+    dt_min = (np.asarray(mjd, np.float64) - polyco["REF_MJD"]) * 1440.0
+    coeffs = np.asarray(polyco["COEFF"], np.float64)
+    poly = np.polynomial.polynomial.polyval(dt_min, coeffs)
+    return polyco["REF_PHS"] + poly + 60.0 * polyco["REF_F0"] * dt_min

@@ -1,0 +1,286 @@
+"""Tests for the IO layer: FITS core, polycos, PSRFITS save, pdv text
+(mirrors reference tests/test_io.py scope against the real NANOGrav
+template)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.io import (
+    Card,
+    FitsFile,
+    Header,
+    PSRFITS,
+    TxtFile,
+    generate_polyco,
+    parse_par,
+    polyco_phase,
+)
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.signal import FilterBankSignal
+from psrsigsim_tpu.utils import make_par
+
+TEMPLATE = "/root/reference/data/B1855+09.L-wide.PUPPI.11y.x.sum.sm"
+
+needs_template = pytest.mark.skipif(
+    not os.path.exists(TEMPLATE), reason="NANOGrav template not available"
+)
+
+
+class TestCards:
+    def test_string_card_roundtrip(self):
+        c = Card.make("TELESCOP", "GBT", "telescope name")
+        assert c.key == "TELESCOP"
+        assert c.value == "GBT"
+        assert "GBT" in c.image
+
+    def test_numeric_cards(self):
+        assert Card.make("NAXIS2", 20).value == 20
+        assert Card.make("TBIN", 2.048e-05).value == pytest.approx(2.048e-05)
+        assert Card.make("SIMPLE", True).value is True
+        assert Card.make("FLAG", False).value is False
+
+    def test_quoted_string_with_apostrophe(self):
+        c = Card.make("OBSERVER", "O'Neil")
+        assert c.value == "O'Neil"
+
+    def test_value_with_comment(self):
+        c = Card.make("NBIN", 2048, "phase bins")
+        assert c.value == 2048
+        assert "phase bins" in c.image
+
+    def test_header_get_set(self):
+        h = Header([Card.make("NCHAN", 64), Card.make("NPOL", 1)])
+        assert h["NCHAN"] == 64
+        h["NCHAN"] = 128
+        assert h["NCHAN"] == 128
+        h["NEWKEY"] = 3.5
+        assert h["NEWKEY"] == 3.5
+        assert "NOPE" not in h
+        assert h.get("NOPE", "x") == "x"
+
+    def test_header_serialize_block_aligned(self):
+        h = Header([Card.make("NCHAN", 64)])
+        raw = h.serialize()
+        assert len(raw) % 2880 == 0
+
+
+@needs_template
+class TestFitsCore:
+    def test_read_template_structure(self):
+        f = FitsFile.read(TEMPLATE)
+        assert f.names() == ["PRIMARY", "HISTORY", "PSRPARAM", "POLYCO",
+                             "SUBINT"]
+        sub = f["SUBINT"]
+        assert sub.header["NBIN"] == 2048
+        assert sub.data["DATA"].dtype == np.dtype(">i2")
+
+    def test_write_read_roundtrip(self, tmp_path):
+        f = FitsFile.read(TEMPLATE)
+        out = str(tmp_path / "copy.fits")
+        f.write(out)
+        g = FitsFile.read(out)
+        assert g.names() == f.names()
+        for name in f.names():
+            a, b = f[name], g[name]
+            if a.data is not None:
+                np.testing.assert_array_equal(a.data, b.data)
+            assert a.header.keys() == b.header.keys()
+
+    def test_roundtrip_preserves_card_images(self, tmp_path):
+        f = FitsFile.read(TEMPLATE)
+        out = str(tmp_path / "copy.fits")
+        f.write(out)
+        g = FitsFile.read(out)
+        for name in f.names():
+            for ca, cb in zip(f[name].header.cards, g[name].header.cards):
+                assert ca.image == cb.image
+
+
+class TestPolyco:
+    def _write_par(self, tmp_path, f0=186.49408124993144, dm=15.99):
+        sig = FilterBankSignal(1400, 400, Nsubband=2)
+        sig._dm = __import__(
+            "psrsigsim_tpu.utils", fromlist=["make_quant"]
+        ).make_quant(dm, "pc/cm^3")
+        psr = Pulsar(1.0 / f0, 0.01, GaussProfile(), name="J1713+0747")
+        par = str(tmp_path / "test.par")
+        make_par(sig, psr, outpar=par)
+        return par, f0
+
+    def test_parse_par(self, tmp_path):
+        par, f0 = self._write_par(tmp_path)
+        params = parse_par(par)
+        assert params["PSR"] == "J1713+0747"
+        assert params["F0"] == pytest.approx(f0)
+        assert params["DM"] == pytest.approx(15.99)
+
+    def test_polyco_keys_and_phase(self, tmp_path):
+        par, f0 = self._write_par(tmp_path)
+        pc = generate_polyco(par, 55999.9861)
+        for key in ("NSPAN", "NCOEF", "REF_FREQ", "NSITE", "REF_F0", "COEFF",
+                    "REF_MJD", "REF_PHS"):
+            assert key in pc
+        assert pc["REF_F0"] == pytest.approx(f0)
+        assert 0.0 <= pc["REF_PHS"] < 1.0
+        assert len(pc["COEFF"]) == 15
+
+    def test_polyco_predicts_spin_phase(self, tmp_path):
+        par, f0 = self._write_par(tmp_path)
+        pc = generate_polyco(par, 55999.9861)
+        # one pulse period later, predicted phase advances by exactly 1 cycle
+        p = 1.0 / f0
+        mjd0 = pc["REF_MJD"]
+        dphi = polyco_phase(pc, mjd0 + p / 86400.0) - polyco_phase(pc, mjd0)
+        # MJD float64 quantization floors phase precision at ~1e-4 cycles
+        # (eps(56000 days) ~ 0.6 us); TEMPO's polyco format shares this
+        assert dphi == pytest.approx(1.0, abs=3e-4)
+
+
+def _simulated(seed=51):
+    sig = FilterBankSignal(1380.78125, 800.0, Nsubband=64, sublen=2.0,
+                           fold=True, sample_rate=0.39)
+    psr = Pulsar(0.00457, 0.03, GaussProfile(width=0.02), name="J1713+0747",
+                 seed=seed)
+    psr.make_pulses(sig, tobs=10.0)
+    from psrsigsim_tpu.ism import ISM
+
+    ISM().disperse(sig, 15.99)
+    return sig, psr
+
+
+@needs_template
+class TestPSRFITS:
+    def test_template_params(self):
+        pfit = PSRFITS(path="/tmp/out.fits", template=TEMPLATE,
+                       obs_mode="PSR")
+        pfit.get_signal_params()
+        assert pfit.nbin == 2048
+        assert pfit.nchan == 1
+        assert pfit.npol == 1
+
+    def test_make_signal_from_psrfits(self):
+        pfit = PSRFITS(path="/tmp/out2.fits", template=TEMPLATE,
+                       obs_mode="PSR")
+        S = pfit.make_signal_from_psrfits()
+        assert S.sigtype == "FilterBankSignal"
+        assert S.Nchan == 1
+        assert S.dm.value == pytest.approx(13.29, abs=0.5)
+
+    def test_save_and_reload_data(self, tmp_path):
+        sig, psr = _simulated()
+        out = str(tmp_path / "sim.fits")
+        par = str(tmp_path / "sim.par")
+        make_par(sig, psr, outpar=par)
+
+        pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="PSR")
+        pfit.get_signal_params(signal=sig)
+        pfit.save(sig, psr, parfile=par, MJD_start=55999.9861)
+
+        f = FitsFile.read(out)
+        sub = f["SUBINT"]
+        assert sub.header["NCHAN"] == 64
+        assert sub.header["NBIN"] == pfit.nbin
+        assert len(sub.data) == sig.nsub
+        # data round-trips through the big-endian int16 cast
+        expect = np.asarray(sig.data)[:, : pfit.nbin * sig.nsub].astype(">i2")
+        for ii in range(sig.nsub):
+            got = sub.data["DATA"][ii][0]  # (nchan, nbin)
+            np.testing.assert_array_equal(
+                got, expect[:, ii * pfit.nbin : (ii + 1) * pfit.nbin]
+            )
+        np.testing.assert_allclose(
+            sub.data["DAT_FREQ"][0], sig.dat_freq.value, rtol=1e-12
+        )
+        np.testing.assert_array_equal(sub.data["DAT_SCL"][0], 1.0)
+        np.testing.assert_array_equal(sub.data["DAT_OFFS"][0], 0.0)
+        np.testing.assert_array_equal(sub.data["DAT_WTS"][0], 1.0)
+
+    def test_save_bit_reproducible(self, tmp_path):
+        out1 = str(tmp_path / "a.fits")
+        out2 = str(tmp_path / "b.fits")
+        for out in (out1, out2):
+            sig, psr = _simulated(seed=51)  # same seed -> same data
+            par = str(tmp_path / "p.par")
+            make_par(sig, psr, outpar=par)
+            pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="PSR")
+            pfit.get_signal_params(signal=sig)
+            pfit.save(sig, psr, parfile=par, MJD_start=55999.9861)
+        with open(out1, "rb") as f1, open(out2, "rb") as f2:
+            assert f1.read() == f2.read()
+
+    def test_polyco_hdu_updated(self, tmp_path):
+        sig, psr = _simulated()
+        out = str(tmp_path / "sim2.fits")
+        par = str(tmp_path / "s.par")
+        make_par(sig, psr, outpar=par)
+        pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="PSR")
+        pfit.get_signal_params(signal=sig)
+        pfit.save(sig, psr, parfile=par, MJD_start=55999.9861)
+        f = FitsFile.read(out)
+        pol = f["POLYCO"].data[0]
+        assert pol["REF_F0"] == pytest.approx(1.0 / 0.00457)
+        assert pol["NSPAN"] == 60.0
+        assert 0.0 <= pol["REF_PHS"] < 1.0
+
+    def test_primary_header_phase_connection(self, tmp_path):
+        sig, psr = _simulated()
+        out = str(tmp_path / "sim3.fits")
+        par = str(tmp_path / "s3.par")
+        make_par(sig, psr, outpar=par)
+        pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="PSR")
+        pfit.get_signal_params(signal=sig)
+        pfit.save(sig, psr, parfile=par, MJD_start=55999.9861,
+                  ref_MJD=56000.0)
+        f = FitsFile.read(out)
+        hdr = f["PRIMARY"].header
+        assert hdr["STT_IMJD"] == 55999
+        assert hdr["CHAN_DM"] == pytest.approx(15.99)
+
+    def test_psrparam_binary_params_pruned(self, tmp_path):
+        sig, psr = _simulated()
+        out = str(tmp_path / "sim4.fits")
+        par = str(tmp_path / "s4.par")
+        make_par(sig, psr, outpar=par)
+        pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="PSR")
+        pfit.get_signal_params(signal=sig)
+        pfit.save(sig, psr, parfile=par, MJD_start=55999.9861)
+        f = FitsFile.read(out)
+        params = [row[0].split()[0] for row in f["PSRPARAM"].data]
+        for banned in (b"BINARY", b"A1", b"PB", b"SINI"):
+            assert banned not in params
+
+    def test_stubs(self):
+        pfit = PSRFITS(path="/tmp/x.fits", template=TEMPLATE, obs_mode="PSR")
+        with pytest.raises(NotImplementedError):
+            pfit.append(None)
+        with pytest.raises(NotImplementedError):
+            pfit.load()
+
+
+class TestTxtFile:
+    def test_pdv_save(self, tmp_path):
+        sig, psr = _simulated()
+        base = str(tmp_path / "sim_pdv.ar")
+        txt = TxtFile(path=base)
+        txt.save_psrchive_pdv(sig, psr)
+        files = sorted(tmp_path.glob("sim_pdv.ar_*.txt"))
+        assert len(files) >= 1
+        first = files[0].read_text().splitlines()
+        assert first[0].startswith("# File:")
+        assert "Src: J1713+0747" in first[0]
+        assert first[1].startswith("# MJD(mid):")
+        # data lines: subint chan bin value
+        parts = first[2].split()
+        assert len(parts) == 4
+        assert parts[0] == "0" and parts[1] == "0" and parts[2] == "0"
+
+    def test_pdv_files_not_overwritten(self, tmp_path):
+        # 5 subints x 64 chans, dump checked per subint: dumps after subints
+        # 2 and 4 plus the final flush -> 3 distinct files (divergence #5 fix)
+        sig, psr = _simulated()
+        base = str(tmp_path / "chunks.ar")
+        TxtFile(path=base).save_psrchive_pdv(sig, psr)
+        files = sorted(tmp_path.glob("chunks.ar_*.txt"))
+        assert len(files) == 3
