@@ -1,0 +1,293 @@
+#!/usr/bin/env python3
+"""Benchmark harness: reference CPU baseline vs the TPU-native pipeline.
+
+Covers the BASELINE.md configs:
+
+  1. J1713-like fold-mode FilterBank, 64 chan, 2048 bins/period, 20 subints
+  2. B1855-like 2048-chan fold-mode + ISM dispersion
+  5. Monte-Carlo fold-mode ensemble (the north-star workload)
+
+The reference package itself cannot import in this image (astropy / pint /
+fitsio are not installed), so the CPU baseline is a line-faithful NumPy/SciPy
+re-creation of the reference's hot path — same algorithm, same serial
+per-channel structure, same shapes:
+
+  - pulse synthesis: ``np.tile(profiles, nsub) * chi2.rvs(...) * draw_norm``
+    (reference pulsar/pulsar.py:196-221)
+  - dispersion: serial per-channel rFFT phase-ramp shift
+    (reference ism/ism.py:40-74 calling utils/utils.py:17-59)
+  - radiometer noise: ``norm * chi2.rvs(size=data.shape)``
+    (reference telescope/receiver.py:140-172)
+
+Both sides consume the identical static config built by
+``psrsigsim_tpu.simulate.build_fold_config``, so the workloads match to the
+sample.
+
+Prints ONE machine-parseable JSON line on stdout (everything else goes to
+stderr): the headline metric is fold-mode observations/sec on the ensemble
+config, ``vs_baseline`` is the speedup over the CPU reference baseline.
+
+Set ``PSS_BENCH_PROFILE=<dir>`` to wrap one steady-state ensemble batch in a
+``jax.profiler.trace`` and save the trace there.
+"""
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline: faithful re-creation of the reference's NumPy path
+# ---------------------------------------------------------------------------
+
+
+def _shift_t_np(y, shift, dt):
+    """Fourier-shift one channel (reference utils/utils.py:52-59)."""
+    yfft = np.fft.rfft(y)
+    fs = np.fft.rfftfreq(len(y), d=dt)
+    yfft_sh = yfft * np.exp(-1j * 2 * np.pi * fs * shift)
+    return np.fft.irfft(yfft_sh)
+
+
+def cpu_reference_obs(profiles, cfg, freqs_mhz, dm, noise_norm, rng):
+    """One fold-mode observation, exactly as the reference computes it.
+
+    Synthesis (pulsar.py:211-221), serial per-channel dispersion
+    (ism.py:42-60), radiometer noise (receiver.py:168-171).
+    """
+    from scipy import stats
+
+    from psrsigsim_tpu.utils.constants import DM_K_MS_MHZ2
+
+    nsub, nfold = cfg.nsub, cfg.nfold
+    sngl_prof = np.tile(profiles, (1, nsub))
+    data = (
+        sngl_prof
+        * stats.chi2.rvs(df=nfold, size=sngl_prof.shape, random_state=rng)
+        * cfg.draw_norm
+    )
+
+    time_delays_ms = DM_K_MS_MHZ2 * dm / freqs_mhz**2
+    for ii in range(data.shape[0]):  # serial loop — reference ism.py:57-60
+        data[ii, :] = _shift_t_np(data[ii, :], time_delays_ms[ii], cfg.dt_ms)
+
+    data += noise_norm * stats.chi2.rvs(
+        df=cfg.noise_df, size=data.shape, random_state=rng
+    )
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Workload construction (shared between both sides)
+# ---------------------------------------------------------------------------
+
+
+def build_workload(nchan, period_s, samprate_mhz, sublen_s, tobs_s, fcent, bw,
+                   smean, dm):
+    """Configure the OO layer and derive the static pipeline config.
+
+    Reuses the driver entry's base psrdict so the bench workload and the
+    compile-checked model stay configured the same way.
+    """
+    from __graft_entry__ import _simdict
+    from psrsigsim_tpu.simulate import Simulation, build_fold_config
+
+    psrdict = _simdict(
+        nchan=nchan,
+        tobs=tobs_s,
+        fcent=fcent,
+        bandwidth=bw,
+        sample_rate=samprate_mhz,
+        sublen=sublen_s,
+        period=period_s,
+        Smean=smean,
+        name="BENCH",
+        dm=dm,
+        rcvr_fcent=fcent,
+        rcvr_bw=bw,
+    )
+    s = Simulation(psrdict=psrdict).init_all()
+    cfg, profiles, noise_norm = build_fold_config(
+        s.signal, s.pulsar, s.tscope, psrdict["system_name"]
+    )
+    freqs = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float64)
+    return s, cfg, np.asarray(profiles, np.float64), noise_norm, freqs
+
+
+CONFIGS = {
+    # 1: tutorial_1/2-style J1713-like: 64-chan L-band fold mode,
+    #    2048 bins/period, 20 x 60 s subints (BASELINE.md config 1)
+    "config1_fold64": dict(
+        nchan=64, period_s=0.005, samprate_mhz=0.4096, sublen_s=60.0,
+        tobs_s=1200.0, fcent=1380.0, bw=400.0, smean=0.009, dm=15.9,
+    ),
+    # 2: B1855-like L-wide PUPPI geometry: 2048 chan, 800 MHz band,
+    #    fold-mode + dispersion (BASELINE.md config 2)
+    "config2_fold2048": dict(
+        nchan=2048, period_s=0.005, samprate_mhz=0.4096, sublen_s=30.0,
+        tobs_s=240.0, fcent=1380.0, bw=800.0, smean=0.005, dm=13.3,
+    ),
+}
+
+# 5: Monte-Carlo ensemble of config-1 observations (BASELINE.md config 5).
+# Batch sized to fit one program's working set in a single v5e chip's HBM
+# (the 10k-obs target streams these batches back-to-back).
+ENSEMBLE_BATCH = 32
+ENSEMBLE_BATCHES = 8
+
+
+def time_cpu(cfg, profiles, noise_norm, freqs, dm, n_obs):
+    rng = np.random.default_rng(0)
+    # one warmup obs so scipy/numpy internals are hot
+    cpu_reference_obs(profiles, cfg, freqs, dm, noise_norm, rng)
+    t0 = time.perf_counter()
+    for _ in range(n_obs):
+        cpu_reference_obs(profiles, cfg, freqs, dm, noise_norm, rng)
+    return (time.perf_counter() - t0) / n_obs
+
+
+def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None, n_iter=4):
+    """Steady-state device time per observation.
+
+    A small batch of observations is vmapped into ONE XLA program and the
+    result blocked on, so per-call dispatch latency (large through the
+    remote-TPU relay) doesn't pollute the number and asynchronous dispatch
+    can't fake one.
+    """
+    import jax
+
+    from psrsigsim_tpu.simulate import fold_pipeline
+
+    if batch is None:
+        # keep one program's working set well inside a single chip's HBM —
+        # the chi2/gamma sampler's temporaries cost tens of bytes per sample
+        batch = max(1, (1 << 26) // (cfg.meta.nchan * cfg.nsamp))
+    prof = np.asarray(profiles, np.float32)
+
+    @jax.jit
+    def run(keys):
+        return jax.vmap(
+            lambda k: fold_pipeline(
+                k, np.float32(dm), np.float32(noise_norm), prof, cfg
+            )
+        )(keys)
+
+    kb = jax.vmap(jax.random.key)(np.arange(batch))
+    run(kb).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for i in range(n_iter):
+        kb = jax.vmap(jax.random.key)(np.arange(batch) + (i + 1) * batch)
+        run(kb).block_until_ready()
+    return (time.perf_counter() - t0) / (n_iter * batch)
+
+
+def time_tpu_ensemble(sim, dm):
+    import jax
+
+    from psrsigsim_tpu.parallel import make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh((n_dev, 1))
+    ens = sim.to_ensemble(mesh=mesh)
+    dms = np.full(ENSEMBLE_BATCH, dm, np.float32)
+
+    out = ens.run(n_obs=ENSEMBLE_BATCH, seed=0, dms=dms)  # compile
+    jax.block_until_ready(out)
+
+    profile_dir = os.environ.get("PSS_BENCH_PROFILE")
+    if profile_dir:
+        with jax.profiler.trace(profile_dir):
+            jax.block_until_ready(ens.run(n_obs=ENSEMBLE_BATCH, seed=99, dms=dms))
+        log(f"profiler trace saved to {profile_dir}")
+
+    t0 = time.perf_counter()
+    for b in range(ENSEMBLE_BATCHES):
+        out = ens.run(n_obs=ENSEMBLE_BATCH, seed=b + 1, dms=dms)
+        # block every batch: on this platform a single trailing block does
+        # not reliably cover previously enqueued programs, and a host fetch
+        # would time the (slow) relay link instead of the chip
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / (ENSEMBLE_BATCHES * ENSEMBLE_BATCH)
+    return dt
+
+
+def main():
+    # keep stdout clean for the single JSON result line: the OO layer's
+    # reference-parity warnings (sub-Nyquist sampling etc.) print to stdout
+    with contextlib.redirect_stdout(sys.stderr):
+        result = _main()
+    print(json.dumps(result))
+
+
+def _main():
+    t_start = time.perf_counter()
+    import jax
+
+    platform = jax.devices()[0].platform
+    log(f"jax {jax.__version__}, devices: {jax.devices()}")
+
+    detail = {"platform": platform}
+
+    # --- single-observation configs 1 and 2 -----------------------------
+    workloads = {}
+    for name, kw in CONFIGS.items():
+        sim, cfg, profiles, noise_norm, freqs = build_workload(**kw)
+        workloads[name] = (sim, cfg, profiles, noise_norm, freqs, kw["dm"])
+        nsamp_total = cfg.meta.nchan * cfg.nsamp
+        # CPU baseline: few obs (serial, linear in n_obs)
+        n_cpu = 4 if cfg.meta.nchan <= 64 else 1
+        t_cpu = time_cpu(cfg, profiles, noise_norm, freqs, kw["dm"], n_cpu)
+        t_tpu = time_tpu_single(cfg, profiles, noise_norm, kw["dm"])
+        detail[name] = {
+            "nchan": cfg.meta.nchan,
+            "nsamp_per_chan": cfg.nsamp,
+            "cpu_s_per_obs": round(t_cpu, 6),
+            "tpu_s_per_obs": round(t_tpu, 6),
+            "tpu_samples_per_sec": round(nsamp_total / t_tpu),
+            "speedup": round(t_cpu / t_tpu, 2),
+        }
+        log(f"{name}: cpu {t_cpu*1e3:.1f} ms/obs, device {t_tpu*1e3:.2f} ms/obs, "
+            f"speedup {t_cpu/t_tpu:.1f}x")
+
+    # --- config 5: Monte-Carlo ensemble ---------------------------------
+    sim, cfg, profiles, noise_norm, freqs, dm = workloads["config1_fold64"]
+    t_cpu_obs = detail["config1_fold64"]["cpu_s_per_obs"]
+    t_tpu_obs = time_tpu_ensemble(sim, dm)
+    obs_per_sec = 1.0 / t_tpu_obs
+    cpu_obs_per_sec = 1.0 / t_cpu_obs
+    speedup = obs_per_sec / cpu_obs_per_sec
+    samples_per_obs = cfg.meta.nchan * cfg.nsamp
+    detail["config5_ensemble"] = {
+        "batch": ENSEMBLE_BATCH,
+        "batches_timed": ENSEMBLE_BATCHES,
+        "tpu_obs_per_sec": round(obs_per_sec, 2),
+        "cpu_obs_per_sec": round(cpu_obs_per_sec, 4),
+        "tpu_samples_per_sec": round(obs_per_sec * samples_per_obs),
+        "speedup": round(speedup, 2),
+    }
+    log(f"config5_ensemble: device {obs_per_sec:.1f} obs/s vs cpu "
+        f"{cpu_obs_per_sec:.2f} obs/s -> {speedup:.1f}x")
+    detail["total_bench_s"] = round(time.perf_counter() - t_start, 1)
+
+    return {
+        "metric": "fold_ensemble_obs_per_sec",
+        "value": round(obs_per_sec, 2),
+        "unit": "obs/s",
+        "vs_baseline": round(speedup, 2),
+        "detail": detail,
+    }
+
+
+if __name__ == "__main__":
+    main()

@@ -222,10 +222,10 @@ class Simulation:
         self.tscope.observe(self.signal, self.pulsar, system=self.system_name,
                             noise=True)
 
-    def to_ensemble(self, mesh=None):
-        """Bridge to the sharded Monte-Carlo runner: same configuration, one
-        jitted pipeline, vmapped + mesh-sharded (TPU-native extension)."""
-        from ..parallel.ensemble import FoldEnsemble
+    def init_all(self):
+        """Initialize every simulation object (signal, profile, pulsar,
+        telescope) and stamp tobs/dm onto the signal — the configuration
+        half of ``simulate()``, shared by the jitted-pipeline entry points."""
         from ..utils.quantity import make_quant
 
         self.init_signal()
@@ -235,6 +235,14 @@ class Simulation:
         self.signal._tobs = make_quant(self.tobs, "s")
         if self.dm is not None:
             self.signal._dm = make_quant(self.dm, "pc/cm^3")
+        return self
+
+    def to_ensemble(self, mesh=None):
+        """Bridge to the sharded Monte-Carlo runner: same configuration, one
+        jitted pipeline, vmapped + mesh-sharded (TPU-native extension)."""
+        from ..parallel.ensemble import FoldEnsemble
+
+        self.init_all()
         return FoldEnsemble(self.signal, self.pulsar, self.tscope,
                             self.system_name, mesh=mesh)
 
