@@ -94,11 +94,13 @@ def cpu_reference_obs(profiles, cfg, freqs_mhz, dm, noise_norm, rng):
 
 
 def build_workload(nchan, period_s, samprate_mhz, sublen_s, tobs_s, fcent, bw,
-                   smean, dm):
+                   smean, dm, real_profile=False):
     """Configure the OO layer and derive the static pipeline config.
 
     Reuses the driver entry's base psrdict so the bench workload and the
-    compile-checked model stay configured the same way.
+    compile-checked model stay configured the same way.  With
+    ``real_profile`` the measured J1713+0747 template drives a DataProfile
+    (BASELINE config 1/5 is a J1713 fold-mode ensemble).
     """
     from __graft_entry__ import _simdict
     from psrsigsim_tpu.simulate import Simulation, build_fold_config
@@ -117,6 +119,10 @@ def build_workload(nchan, period_s, samprate_mhz, sublen_s, tobs_s, fcent, bw,
         rcvr_fcent=fcent,
         rcvr_bw=bw,
     )
+    if real_profile:
+        from psrsigsim_tpu.data import data_path
+
+        psrdict["profiles"] = np.load(data_path("J1713+0747_profile.npy"))
     s = Simulation(psrdict=psrdict).init_all()
     cfg, profiles, noise_norm = build_fold_config(
         s.signal, s.pulsar, s.tscope, psrdict["system_name"]
@@ -131,6 +137,7 @@ CONFIGS = {
     "config1_fold64": dict(
         nchan=64, period_s=0.005, samprate_mhz=0.4096, sublen_s=60.0,
         tobs_s=1200.0, fcent=1380.0, bw=400.0, smean=0.009, dm=15.9,
+        real_profile=True,
     ),
     # 2: B1855-like L-wide PUPPI geometry: 2048 chan, 800 MHz band,
     #    fold-mode + dispersion (BASELINE.md config 2)

@@ -187,6 +187,16 @@ class TestHostNumerics:
         with pytest.raises(ValueError):
             text_search(["1400"], ["FLUX"], str(p))
 
+    def test_text_search_vendored_fixture(self):
+        # the reference's own test table (vendored at data/); its header
+        # line starts with '#', so address columns numerically
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "data",
+                            "txt_search_test.txt")
+        vals = text_search(["pull"], [1, 2], path)
+        assert vals == (7.0, 1.0)
+
     def test_kolmogorov_beta(self):
         assert KOLMOGOROV_BETA == pytest.approx(11.0 / 3.0)
 
