@@ -5,6 +5,8 @@ Covers the BASELINE.md configs:
 
   1. J1713-like fold-mode FilterBank, 64 chan, 2048 bins/period, 20 subints
   2. B1855-like 2048-chan fold-mode + ISM dispersion
+  3. Baseband Nyquist-sampled stream + coherent dedispersion
+  4. SEARCH-mode single-pulse stream with pulse nulling
   5. Monte-Carlo fold-mode ensemble (the north-star workload)
 
 The reference package itself cannot import in this image (astropy / pint /
@@ -88,6 +90,71 @@ def cpu_reference_obs(profiles, cfg, freqs_mhz, dm, noise_norm, rng):
     return data
 
 
+def cpu_reference_single_obs(profiles, cfg, freqs_mhz, dm, noise_norm, rng):
+    """One SEARCH-mode observation the reference's way: single-pulse chi2
+    synthesis at every sample phase (pulsar.py:222-244), per-pulse nulling
+    mask built in a Python loop (pulsar.py:246-304), serial per-channel
+    dispersion (ism.py:42-60), chi2 df=1 noise (receiver.py:160-171)."""
+    from scipy import stats
+
+    from psrsigsim_tpu.utils.constants import DM_K_MS_MHZ2
+
+    idx = np.arange(cfg.nsamp) % cfg.nph
+    data = (
+        profiles[:, idx]
+        * stats.chi2.rvs(df=1, size=(profiles.shape[0], cfg.nsamp),
+                         random_state=rng)
+        * cfg.draw_norm
+    )
+
+    if cfg.n_null:
+        shift_val = cfg.nph // 2 - cfg.peak_bin
+        sel = rng.permutation(cfg.nsub)[: cfg.n_null]
+        mask_row = np.zeros(cfg.nsamp, dtype=bool)
+        for p in sel:  # serial per-pulse loop — reference pulsar.py:293-304
+            lo = cfg.nph * int(p) + shift_val
+            bins = np.arange(lo, lo + cfg.nph)
+            bins = bins[(bins >= 0) & (bins < cfg.nsamp)]
+            mask_row[bins] = True
+        # ONE noise row broadcast to all channels (reference pulsar.py:304)
+        repl_row = (
+            stats.chi2.rvs(df=cfg.null_df, size=mask_row.sum(),
+                           random_state=rng)
+            * cfg.draw_norm
+            * cfg.off_pulse_mean
+        )
+        data[:, mask_row] = repl_row[None, :]
+
+    time_delays_ms = DM_K_MS_MHZ2 * dm / freqs_mhz**2
+    for ii in range(data.shape[0]):  # serial loop — reference ism.py:57-60
+        data[ii, :] = _shift_t_np(data[ii, :], time_delays_ms[ii], cfg.dt_ms)
+
+    data += noise_norm * stats.chi2.rvs(
+        df=cfg.noise_df, size=data.shape, random_state=rng
+    )
+    return data
+
+
+def cpu_reference_baseband_obs(sqrt_profiles, cfg, dm, rng):
+    """One baseband observation the reference's way: amplitude synthesis
+    (pulsar.py:153-183) then per-pol-channel coherent dispersion — serial
+    rFFT x H x irFFT per channel (ism.py:82-98)."""
+    from psrsigsim_tpu.ops.shift import coherent_dedispersion_transfer
+
+    idx = np.arange(cfg.nsamp) % cfg.nph
+    data = sqrt_profiles[:, idx] * rng.standard_normal(
+        (sqrt_profiles.shape[0], cfg.nsamp)
+    )
+
+    re, im = coherent_dedispersion_transfer(
+        cfg.nsamp, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us
+    )
+    H = np.asarray(re, np.float64) + 1j * np.asarray(im, np.float64)
+    for ii in range(data.shape[0]):  # serial pol loop — reference ism.py:82-98
+        data[ii, :] = np.fft.irfft(np.fft.rfft(data[ii, :]) * H, n=cfg.nsamp)
+    return data
+
+
 # ---------------------------------------------------------------------------
 # Workload construction (shared between both sides)
 # ---------------------------------------------------------------------------
@@ -147,6 +214,42 @@ CONFIGS = {
     ),
 }
 
+def build_single_workload():
+    """BASELINE config 4: 64-chan SEARCH-mode stream, 2 s, 20% nulling."""
+    from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+    from psrsigsim_tpu.signal import FilterBankSignal
+    from psrsigsim_tpu.simulate import build_single_config
+    from psrsigsim_tpu.telescope import Backend, Receiver, Telescope
+    from psrsigsim_tpu.utils import make_quant
+
+    sig = FilterBankSignal(1380, 400, Nsubband=64, sample_rate=0.4096,
+                           fold=False)
+    psr = Pulsar(0.005, 0.05, GaussProfile(width=0.05), name="BENCH", seed=0)
+    sig._tobs = make_quant(2.0, "s")
+    t = Telescope(100.0, area=5500.0, Tsys=35.0, name="BenchScope")
+    t.add_system("BenchSys", Receiver(fcent=1380, bandwidth=400, name="R"),
+                 Backend(samprate=12.5, name="B"))
+    cfg, profiles, noise_norm = build_single_config(
+        sig, psr, t, "BenchSys", null_frac=0.2
+    )
+    freqs = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float64)
+    return cfg, np.asarray(profiles, np.float64), noise_norm, freqs
+
+
+def build_baseband_workload():
+    """BASELINE config 3: Nyquist-sampled baseband + coherent dedispersion."""
+    from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+    from psrsigsim_tpu.signal import BasebandSignal
+    from psrsigsim_tpu.simulate import build_baseband_config
+    from psrsigsim_tpu.utils import make_quant
+
+    sig = BasebandSignal(1400, 100, sample_rate=200.0)  # Nyquist: 2 x bw
+    psr = Pulsar(0.005, 0.05, GaussProfile(width=0.05), name="BENCH", seed=0)
+    sig._tobs = make_quant(0.02, "s")
+    cfg, sqrt_profiles, noise_norm = build_baseband_config(sig, psr)
+    return cfg, np.asarray(sqrt_profiles, np.float64), noise_norm
+
+
 # 5: Monte-Carlo ensemble of config-1 observations (BASELINE.md config 5).
 # Batch sized to fit one program's working set in a single v5e chip's HBM
 # (the 10k-obs target streams these batches back-to-back).
@@ -154,17 +257,19 @@ ENSEMBLE_BATCH = 32
 ENSEMBLE_BATCHES = 8
 
 
-def time_cpu(cfg, profiles, noise_norm, freqs, dm, n_obs):
+def time_cpu(cfg, profiles, noise_norm, freqs, dm, n_obs,
+             fn=cpu_reference_obs):
     rng = np.random.default_rng(0)
     # one warmup obs so scipy/numpy internals are hot
-    cpu_reference_obs(profiles, cfg, freqs, dm, noise_norm, rng)
+    fn(profiles, cfg, freqs, dm, noise_norm, rng)
     t0 = time.perf_counter()
     for _ in range(n_obs):
-        cpu_reference_obs(profiles, cfg, freqs, dm, noise_norm, rng)
+        fn(profiles, cfg, freqs, dm, noise_norm, rng)
     return (time.perf_counter() - t0) / n_obs
 
 
-def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None, n_iter=4):
+def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None, n_iter=4,
+                    pipeline=None):
     """Steady-state device time per observation.
 
     A small batch of observations is vmapped into ONE XLA program and the
@@ -174,7 +279,8 @@ def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None, n_iter=4):
     """
     import jax
 
-    from psrsigsim_tpu.simulate import fold_pipeline
+    if pipeline is None:
+        from psrsigsim_tpu.simulate import fold_pipeline as pipeline
 
     if batch is None:
         # keep one program's working set well inside a single chip's HBM —
@@ -185,7 +291,7 @@ def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None, n_iter=4):
     @jax.jit
     def run(keys):
         return jax.vmap(
-            lambda k: fold_pipeline(
+            lambda k: pipeline(
                 k, np.float32(dm), np.float32(noise_norm), prof, cfg
             )
         )(keys)
@@ -266,6 +372,45 @@ def _main():
         }
         log(f"{name}: cpu {t_cpu*1e3:.1f} ms/obs, device {t_tpu*1e3:.2f} ms/obs, "
             f"speedup {t_cpu/t_tpu:.1f}x")
+
+    # --- config 4: SEARCH-mode single-pulse stream with nulling ---------
+    from psrsigsim_tpu.simulate import baseband_pipeline, single_pipeline
+
+    cfg4, prof4, nn4, freqs4 = build_single_workload()
+    t_cpu4 = time_cpu(cfg4, prof4, nn4, freqs4, 15.9, 1,
+                      fn=cpu_reference_single_obs)
+    t_tpu4 = time_tpu_single(cfg4, prof4, nn4, 15.9, pipeline=single_pipeline)
+    detail["config4_search_null"] = {
+        "nchan": cfg4.meta.nchan,
+        "nsamp_per_chan": cfg4.nsamp,
+        "n_null": cfg4.n_null,
+        "cpu_s_per_obs": round(t_cpu4, 6),
+        "tpu_s_per_obs": round(t_tpu4, 6),
+        "tpu_samples_per_sec": round(cfg4.meta.nchan * cfg4.nsamp / t_tpu4),
+        "speedup": round(t_cpu4 / t_tpu4, 2),
+    }
+    log(f"config4_search_null: cpu {t_cpu4*1e3:.1f} ms/obs, device "
+        f"{t_tpu4*1e3:.2f} ms/obs, speedup {t_cpu4/t_tpu4:.1f}x")
+
+    # --- config 3: baseband coherent dedispersion -----------------------
+    cfg3, sprof3, nn3 = build_baseband_workload()
+    t_cpu3 = time_cpu(
+        cfg3, sprof3, nn3, None, 13.3, 2,
+        fn=lambda p, c, f, d, nn, r: cpu_reference_baseband_obs(p, c, d, r),
+    )
+    t_tpu3 = time_tpu_single(cfg3, sprof3, nn3, 13.3,
+                             pipeline=baseband_pipeline)
+    npol = sprof3.shape[0]
+    detail["config3_baseband"] = {
+        "npol": npol,
+        "nsamp_per_pol": cfg3.nsamp,
+        "cpu_s_per_obs": round(t_cpu3, 6),
+        "tpu_s_per_obs": round(t_tpu3, 6),
+        "tpu_samples_per_sec": round(npol * cfg3.nsamp / t_tpu3),
+        "speedup": round(t_cpu3 / t_tpu3, 2),
+    }
+    log(f"config3_baseband: cpu {t_cpu3*1e3:.1f} ms/obs, device "
+        f"{t_tpu3*1e3:.2f} ms/obs, speedup {t_cpu3/t_tpu3:.1f}x")
 
     # --- config 5: Monte-Carlo ensemble ---------------------------------
     sim, cfg, profiles, noise_norm, freqs, dm = workloads["config1_fold64"]

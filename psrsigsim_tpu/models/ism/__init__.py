@@ -1,5 +1,5 @@
 """ISM propagation models (reference layer: psrsigsim/ism/)."""
 
-from .ism import ISM
+from .ism import ISM, fd_delays_ms, scatter_delays_ms
 
-__all__ = ["ISM"]
+__all__ = ["ISM", "fd_delays_ms", "scatter_delays_ms"]

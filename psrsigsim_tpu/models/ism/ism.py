@@ -17,7 +17,42 @@ from ...utils.constants import DM_K, KOLMOGOROV_BETA
 from ...utils.quantity import Quantity, make_quant
 from ..pulsar.portraits import DataPortrait
 
-__all__ = ["ISM"]
+__all__ = ["ISM", "fd_delays_ms", "scatter_delays_ms"]
+
+
+def fd_delays_ms(freqs_mhz, fd_params_s):
+    """Per-channel FD-polynomial delays in ms:
+    ``sum_i c_i ln(f/1GHz)^(i+1)`` with coefficients in seconds
+    (Arzoumanian et al. 2016; reference: ism/ism.py:100-156).
+
+    Pure host function — the delay vector feeds the batched Fourier shift
+    (either :meth:`ISM.FD_shift` or an in-graph pipeline stage)."""
+    freqs_mhz = np.asarray(freqs_mhz, dtype=np.float64)
+    log_ratio = np.log(freqs_mhz / 1000.0)
+    delays_ms = np.zeros_like(freqs_mhz)
+    for ii, c in enumerate(fd_params_s):
+        delays_ms += 1e3 * float(c) * log_ratio ** (ii + 1)
+    return delays_ms
+
+
+def _tau_d_exponent(beta):
+    """Scattering-scaling exponent (thin/thick screen branches; reference:
+    ism/ism.py:340-358)."""
+    if beta < 4:
+        return -2.0 * beta / (beta - 2)
+    if beta > 4:
+        return -8.0 / (6 - beta)
+    raise ValueError("beta == 4 is a degenerate scaling (reference leaves "
+                     "it undefined); use beta < 4 or beta > 4")
+
+
+def scatter_delays_ms(freqs_mhz, tau_d_s, ref_freq_mhz, beta=KOLMOGOROV_BETA):
+    """Per-channel scatter-broadening delays in ms: tau_d scaled from
+    ``ref_freq`` to each channel by the thin/thick-screen law
+    (reference: ism/ism.py:158-220,340-358).  Pure host function."""
+    freqs_mhz = np.asarray(freqs_mhz, dtype=np.float64)
+    exp = _tau_d_exponent(beta)
+    return 1e3 * float(tau_d_s) * (freqs_mhz / float(ref_freq_mhz)) ** exp
 
 
 class ISM:
@@ -80,11 +115,10 @@ class ISM:
         FD params are in seconds; delays applied in ms.
         """
         freq_array = signal.dat_freq
-        ref_freq = make_quant(1000.0, "MHz")
-        log_ratio = np.log((freq_array / ref_freq).value)
-        delays_ms = np.zeros(len(freq_array), dtype=np.float64)
-        for ii, c in enumerate(FD_params):
-            delays_ms += make_quant(c, "s").to("ms").value * log_ratio ** (ii + 1)
+        delays_ms = fd_delays_ms(
+            freq_array.to("MHz").value,
+            [make_quant(c, "s").to("s").value for c in FD_params],
+        )
         time_delays = Quantity(delays_ms, "ms")
 
         signal.delay = (
@@ -182,7 +216,4 @@ class ISM:
     def scale_tau_d(self, tau_d, nu_i, nu_f, beta=KOLMOGOROV_BETA):
         """Scattering timescale scaling: tau_d ∝ nu^(-2β/(β-2)) (thin screen)
         (reference: ism/ism.py:340-358)."""
-        exp = self._beta_exponent(
-            beta, lambda b: -2.0 * b / (b - 2), lambda b: -8.0 / (6 - b)
-        )
-        return tau_d * (nu_f / nu_i) ** exp
+        return tau_d * (nu_f / nu_i) ** _tau_d_exponent(beta)

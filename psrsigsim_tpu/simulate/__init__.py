@@ -1,11 +1,17 @@
-"""Orchestration: the Simulation façade + the jitted functional pipeline
+"""Orchestration: the Simulation façade + the jitted functional pipelines
 (reference layer: psrsigsim/simulate/)."""
 
 from .pipeline import (
+    BasebandPipelineConfig,
     FoldPipelineConfig,
+    SinglePipelineConfig,
+    baseband_pipeline,
+    build_baseband_config,
     build_fold_config,
+    build_single_config,
     fold_pipeline,
     fold_pipeline_batch,
+    single_pipeline,
 )
 from .simulate import Simulation
 
@@ -15,4 +21,10 @@ __all__ = [
     "fold_pipeline_batch",
     "build_fold_config",
     "FoldPipelineConfig",
+    "single_pipeline",
+    "build_single_config",
+    "SinglePipelineConfig",
+    "baseband_pipeline",
+    "build_baseband_config",
+    "BasebandPipelineConfig",
 ]

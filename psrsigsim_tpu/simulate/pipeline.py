@@ -23,8 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.shift import fourier_shift
-from ..ops.stats import chi2_sample
+from ..ops.shift import coherent_dedisperse, fourier_shift
+from ..ops.stats import chi2_sample, normal_sample
 from ..signal.state import SignalMeta
 from ..utils.constants import DM_K_MS_MHZ2
 from ..utils.rng import stage_key
@@ -34,6 +34,12 @@ __all__ = [
     "fold_pipeline",
     "fold_pipeline_batch",
     "build_fold_config",
+    "SinglePipelineConfig",
+    "single_pipeline",
+    "build_single_config",
+    "BasebandPipelineConfig",
+    "baseband_pipeline",
+    "build_baseband_config",
 ]
 
 
@@ -62,8 +68,33 @@ def _freqs_mhz(cfg):
     return jnp.asarray(cfg.meta.dat_freq_mhz(), dtype=jnp.float32)
 
 
+def _chan_chi2(key, chan_ids, df, nsamp):
+    """Per-channel chi2 draws keyed by GLOBAL channel id: results are
+    bit-identical for any mesh shape or channel-shard split."""
+    return jax.vmap(
+        lambda c: chi2_sample(jax.random.fold_in(key, c), df, (nsamp,))
+    )(chan_ids)
+
+
+def _chan_normal(key, chan_ids, nsamp):
+    """Per-channel N(0,1) draws keyed by GLOBAL channel id."""
+    return jax.vmap(
+        lambda c: normal_sample(jax.random.fold_in(key, c), (nsamp,))
+    )(chan_ids)
+
+
+def _dispersion_delays(dm, freqs, extra_delays_ms):
+    """DM + FD + scatter delays composed additively for the ONE batched
+    Fourier shift (the reference runs three serial per-channel passes)."""
+    delays_ms = DM_K_MS_MHZ2 * dm / freqs**2
+    if extra_delays_ms is not None:
+        delays_ms = delays_ms + extra_delays_ms
+    return delays_ms
+
+
 @partial(jax.jit, static_argnames=("cfg",))
-def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None):
+def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
+                  extra_delays_ms=None):
     """One fold-mode observation: synthesis + dispersion + radiometer noise.
 
     Args:
@@ -81,6 +112,13 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None)
             All random draws are keyed by (observation key, stage, global
             channel), so results are bit-identical for any mesh shape or
             channel-shard split.
+        extra_delays_ms: optional per-channel delays (ms) added to the DM
+            delays before the ONE batched Fourier shift — this is how FD
+            polynomial shifts and direct scatter-broadening shifts enter the
+            graph (host helpers: :func:`psrsigsim_tpu.models.ism.fd_delays_ms`,
+            :func:`~psrsigsim_tpu.models.ism.scatter_delays_ms`; reference
+            applies each as its own serial per-channel pass,
+            ism/ism.py:100-156,158-220).
 
     Returns:
         ``(Nchan, nsub*Nph)`` float32 block (unclipped — clipping belongs to
@@ -94,25 +132,17 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None)
         chan_ids = jnp.arange(freqs.shape[0])
 
     nsamp = cfg.nsub * cfg.nph
-    chan_draw = jax.vmap(
-        lambda k, c: chi2_sample(jax.random.fold_in(k, c), cfg.nfold, (nsamp,)),
-        in_axes=(None, 0),
-    )
-    chan_noise = jax.vmap(
-        lambda k, c: chi2_sample(jax.random.fold_in(k, c), cfg.noise_df, (nsamp,)),
-        in_axes=(None, 0),
-    )
 
     # pulse synthesis (reference: pulsar.py:196-221)
     block = jnp.tile(profiles, (1, cfg.nsub))
-    block = block * chan_draw(kp, chan_ids) * cfg.draw_norm
+    block = block * _chan_chi2(kp, chan_ids, cfg.nfold, nsamp) * cfg.draw_norm
 
-    # dispersion (reference: ism/ism.py:40-74), delays from the traced DM
-    delays_ms = DM_K_MS_MHZ2 * dm / freqs**2
+    # dispersion (+ FD/scatter) as ONE batched shift (reference ism.py:40-74)
+    delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
     block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
 
     # radiometer noise (reference: receiver.py:140-172)
-    return block + chan_noise(kn, chan_ids) * noise_norm
+    return block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * noise_norm
 
 
 def fold_pipeline_batch(cfg, shared_profiles=True):
@@ -183,3 +213,277 @@ def build_fold_config(signal, pulsar, telescope, system, Tsys=None):
         clip_max=float(signal._draw_max),
     )
     return cfg, profiles_np, float(noise_norm)
+
+
+# ---------------------------------------------------------------------------
+# Single-pulse / SEARCH-mode pipeline (BASELINE config 4)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SinglePipelineConfig:
+    """Static configuration of a single-pulse (SEARCH-mode) observation.
+
+    Requires an integer number of samples per period (asserted by the
+    builder): profile evaluation at every sample phase then reduces to ONE
+    modulo-gather of the ``(Nchan, Nph)`` portrait instead of the
+    reference's serial host PCHIP evaluation at ``nsamp`` phases
+    (reference: pulsar.py:222-244).  Non-integer sampling stays on the OO
+    path, which interpolates like the reference.
+    """
+
+    meta: SignalMeta
+    period_s: float
+    nph: int          # samples per period
+    nsub: int         # number of pulses in the stream
+    nsamp: int        # total samples (= int(tobs * samprate))
+    draw_norm: float  # int8 dynamic-range scaling (fb_signal.py:114-121)
+    noise_df: float   # chi2 df of the radiometer noise draws (1 for search)
+    dt_ms: float
+    clip_max: float
+    n_null: int = 0          # pulses to null (round(nsub * null_frac))
+    null_df: float = 1.0     # chi2 df of replacement noise (pulsar.py:297)
+    off_pulse_mean: float = 0.0  # mean off-pulse level (pulsar.py:301)
+    peak_bin: int = 0        # argmax of channel-0 profile (pulse alignment)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
+                    chan_ids=None, extra_delays_ms=None):
+    """One SEARCH-mode observation as one XLA program: single-pulse
+    synthesis (chi2 df=1), in-graph pulse nulling, dispersion, radiometer
+    noise — the reference's ``make_pulses(fold=False) -> null -> disperse ->
+    observe`` chain (pulsar.py:222-333, ism.py:40-74, receiver.py:140-172).
+
+    Nulling diverges from the reference in one documented way: the pulse
+    window is aligned to the PORTRAIT peak (static ``cfg.peak_bin``) rather
+    than to the peak of the first noisy channel-0 pulse — same window in
+    expectation, deterministic in-graph.
+
+    Args/returns: as :func:`fold_pipeline`; returns ``(Nchan, nsamp)``.
+    """
+    kp = stage_key(key, "pulse")
+    kn = stage_key(key, "noise")
+    if freqs is None:
+        freqs = _freqs_mhz(cfg)
+    if chan_ids is None:
+        chan_ids = jnp.arange(freqs.shape[0])
+
+    nsamp = cfg.nsamp
+    # profile value at every sample phase: modulo gather (integer spp)
+    idx = jnp.arange(nsamp, dtype=jnp.int32) % cfg.nph
+    block = jnp.take(profiles, idx, axis=1)
+
+    block = block * _chan_chi2(kp, chan_ids, 1.0, nsamp) * cfg.draw_norm
+
+    # pulse nulling (reference: pulsar.py:246-333) — static mask arithmetic,
+    # no boolean indexing.  Same keys for every channel shard -> both the
+    # nulled pulse set AND the replacement noise row are identical across
+    # any mesh split, matching the reference's row-broadcast assignment
+    # (pulsar.py:304: one noise row written to all channels).
+    if cfg.n_null > 0:
+        ksel = stage_key(key, "null_select")
+        knz = stage_key(key, "null_noise")
+        sel = jax.random.permutation(ksel, cfg.nsub)[: cfg.n_null]
+        nulled = jnp.zeros(cfg.nsub + 1, bool).at[sel].set(True)  # +1: guard row
+        shift_val = cfg.nph // 2 - cfg.peak_bin
+        pulse_id = (jnp.arange(nsamp, dtype=jnp.int32) - shift_val) // cfg.nph
+        in_range = (pulse_id >= 0) & (pulse_id < cfg.nsub)
+        mask_row = jnp.where(in_range, nulled[jnp.clip(pulse_id, 0, cfg.nsub)],
+                             False)
+        repl_row = (
+            chi2_sample(knz, cfg.null_df, (nsamp,))
+            * cfg.draw_norm
+            * cfg.off_pulse_mean
+        )
+        block = jnp.where(mask_row[None, :], repl_row[None, :], block)
+
+    # dispersion (+ FD/scatter) as ONE batched shift
+    delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
+    block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
+
+    # radiometer noise, chi2 df=1 in search mode (receiver.py:160-164)
+    return block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * noise_norm
+
+
+def build_single_config(signal, pulsar, telescope, system, Tsys=None,
+                        null_frac=0.0):
+    """Derive the static config + host inputs for the SEARCH-mode pipeline
+    from configured OO objects (mirror of :func:`build_fold_config` for
+    ``fold=False`` signals; reference semantics pulsar.py:222-244).
+
+    Returns ``(cfg, profiles_np, noise_norm)``.
+    """
+    if signal.fold:
+        raise ValueError("build_single_config requires fold=False (SEARCH mode)")
+
+    period_s = float(pulsar.period.to("s").value)
+    spp = float((signal.samprate * pulsar.period).decompose())
+    nph = int(round(spp))
+    if abs(spp - nph) > 1e-6 * max(1.0, nph):
+        raise ValueError(
+            f"samples per period must be integral for the in-graph SEARCH "
+            f"pipeline (got {spp}); use the OO path for fractional sampling"
+        )
+    tobs = signal.tobs
+    if tobs is None:
+        raise ValueError("set signal._tobs (or pass tobs through Simulation) first")
+    tobs_s = float(tobs.to("s").value)
+    nsub = int(np.round(tobs_s / period_s))
+    nsamp = int(tobs_s * float(signal.samprate.to("MHz").value) * 1e6)
+
+    if pulsar.ref_freq is None:
+        pulsar._ref_freq = signal.fcent
+    if signal.sigtype == "FilterBankSignal" and pulsar.specidx != 0.0:
+        pulsar._add_spec_idx(signal)
+    pulsar.Profiles.init_profiles(nph, signal.Nchan)
+    profiles_np = np.asarray(pulsar.Profiles.profiles, dtype=np.float32)
+    pr = pulsar.Profiles._max_profile
+    signal._Smax = pulsar.Smean * len(pr) / float(np.sum(pr))
+
+    # signal bookkeeping as make_pulses(fold=False) would do (pulsar.py:222-236)
+    signal._sublen = pulsar.period
+    signal._nsub = nsub
+    signal._nsamp = nsamp
+    signal._Nfold = None
+    signal._set_draw_norm(df=1)
+
+    # nulling statics (reference: pulsar.py:246-333)
+    n_null = int(np.round(nsub * null_frac))
+    opw = pulsar.Profiles._calcOffpulseWindow(Nphase=nph)
+    off_pulse_mean = float(np.mean(pr[np.asarray(opw, int)]))
+    peak_bin = int(np.argmax(profiles_np[0]))
+
+    rcvr, _ = telescope.systems[system]
+    tsys = rcvr._resolve_tsys(Tsys if Tsys is not None else telescope.Tsys, None)
+    noise_norm, noise_df = rcvr._pow_noise_norm(signal, tsys, telescope.gain, pulsar)
+
+    cfg = SinglePipelineConfig(
+        meta=signal.meta(),
+        period_s=period_s,
+        nph=nph,
+        nsub=nsub,
+        nsamp=nsamp,
+        draw_norm=float(signal._draw_norm),
+        noise_df=float(noise_df),
+        dt_ms=float((1 / signal.samprate).to("ms").value),
+        clip_max=float(signal._draw_max),
+        n_null=n_null,
+        null_df=1.0,
+        off_pulse_mean=off_pulse_mean,
+        peak_bin=peak_bin,
+    )
+    return cfg, profiles_np, float(noise_norm)
+
+
+# ---------------------------------------------------------------------------
+# Baseband coherent-dedispersion pipeline (BASELINE config 3)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BasebandPipelineConfig:
+    """Static configuration of a baseband (amplitude-signal) observation:
+    Nyquist-sampled voltage-like data, coherent dispersion by the L&K
+    eq 5.21 transfer function (reference: pulsar.py:153-183, ism.py:76-98)."""
+
+    meta: SignalMeta
+    period_s: float
+    nph: int
+    nsamp: int
+    fcent_mhz: float
+    bw_mhz: float
+    dt_us: float
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg, chan_ids=None):
+    """One baseband observation as one XLA program: amplitude synthesis
+    (sqrt-profile x N(0,1); reference pulsar.py:153-183), coherent
+    dispersion (all pol channels in one batched FFT; reference ism.py:76-98
+    loops them serially), and amplitude radiometer noise
+    (reference receiver.py:123-138).
+
+    Args:
+        key, dm, noise_norm: as :func:`fold_pipeline` (noise_norm from
+            :meth:`Receiver._amp_noise_norm` semantics; 0 to disable).
+        sqrt_profiles: ``sqrt(profile)`` at each phase bin, ``(Npol, Nph)``.
+        cfg: static :class:`BasebandPipelineConfig`.
+        chan_ids: global pol-channel indices (shard invariance).
+
+    Returns ``(Npol, nsamp)`` float32.
+
+    Precision note: with a traced ``dm`` the dispersion phase is built in
+    float32 (mod-2π reduction happens in-graph); pass a concrete scalar via
+    the OO path (``ISM.disperse``) when float64-grade phase is required.
+    """
+    kp = stage_key(key, "pulse")
+    kn = stage_key(key, "noise")
+    if chan_ids is None:
+        chan_ids = jnp.arange(sqrt_profiles.shape[0])
+
+    nsamp = cfg.nsamp
+    idx = jnp.arange(nsamp, dtype=jnp.int32) % cfg.nph
+    amp = jnp.take(sqrt_profiles, idx, axis=1)
+
+    block = amp * _chan_normal(kp, chan_ids, nsamp)
+
+    block = coherent_dedisperse(
+        block, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us
+    )
+
+    return block + _chan_normal(kn, chan_ids, nsamp) * noise_norm
+
+
+def build_baseband_config(signal, pulsar, telescope=None, system=None,
+                          Tsys=None):
+    """Derive the static config + host inputs for the baseband pipeline.
+
+    Returns ``(cfg, sqrt_profiles_np, noise_norm)``.  ``noise_norm`` is 0
+    when no telescope/system is given (the reference's ``observe`` raises
+    for baseband signals, telescope.py:86-87; noise enters via
+    ``Receiver.radiometer_noise`` directly, receiver.py:123-138).
+    """
+    if signal.sigtype != "BasebandSignal":
+        raise ValueError("build_baseband_config requires a BasebandSignal")
+
+    period_s = float(pulsar.period.to("s").value)
+    spp = float((signal.samprate * pulsar.period).decompose())
+    nph = int(round(spp))
+    if abs(spp - nph) > 1e-6 * max(1.0, nph):
+        raise ValueError(
+            f"samples per period must be integral for the in-graph baseband "
+            f"pipeline (got {spp}); use the OO path for fractional sampling"
+        )
+    tobs = signal.tobs
+    if tobs is None:
+        raise ValueError("set signal._tobs (or pass tobs through Simulation) first")
+    tobs_s = float(tobs.to("s").value)
+    nsamp = int(tobs_s * float(signal.samprate.to("MHz").value) * 1e6)
+
+    if pulsar.ref_freq is None:
+        pulsar._ref_freq = signal.fcent
+    pulsar.Profiles.init_profiles(nph, signal.Nchan)
+    profiles_np = np.asarray(pulsar.Profiles.profiles, dtype=np.float64)
+    pr = pulsar.Profiles._max_profile
+    signal._Smax = pulsar.Smean * len(pr) / float(np.sum(pr))
+    signal._nsamp = nsamp
+
+    noise_norm = 0.0
+    if telescope is not None and system is not None:
+        rcvr, _ = telescope.systems[system]
+        tsys = rcvr._resolve_tsys(
+            Tsys if Tsys is not None else telescope.Tsys, None
+        )
+        noise_norm = rcvr._amp_noise_norm(signal, tsys, telescope.gain, pulsar)
+
+    cfg = BasebandPipelineConfig(
+        meta=signal.meta(),
+        period_s=period_s,
+        nph=nph,
+        nsamp=nsamp,
+        fcent_mhz=float(signal.fcent.to("MHz").value),
+        bw_mhz=float(signal.bw.to("MHz").value),
+        dt_us=float((1 / signal.samprate).to("us").value),
+    )
+    return cfg, np.sqrt(profiles_np).astype(np.float32), float(noise_norm)

@@ -1,0 +1,236 @@
+"""Parity tests for the extended jitted pipelines: SEARCH-mode single-pulse
+(+ in-graph nulling), baseband coherent dedispersion, and the composed
+FD/scatter delay stage — each pipeline is ONE XLA program checked against
+the OO path (reference semantics: pulsar.py:222-333, ism.py:76-156)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from psrsigsim_tpu.ism import ISM
+from psrsigsim_tpu.models.ism import fd_delays_ms, scatter_delays_ms
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.signal import BasebandSignal, FilterBankSignal
+from psrsigsim_tpu.simulate import (
+    build_baseband_config,
+    build_fold_config,
+    build_single_config,
+    baseband_pipeline,
+    fold_pipeline,
+    single_pipeline,
+)
+from psrsigsim_tpu.telescope import Receiver, Backend, Telescope
+from psrsigsim_tpu.utils import make_quant
+
+
+def _telescope():
+    t = Telescope(20.0, area=5500.0, Tsys=35.0, name="TestScope")
+    t.add_system("TestSys", Receiver(fcent=1400, bandwidth=400, name="R"),
+                 Backend(samprate=0.2048, name="B"))
+    return t
+
+
+def _search_setup(null_frac=0.0, tobs=0.05):
+    sig = FilterBankSignal(1400, 400, Nsubband=2, sample_rate=0.2048,
+                           fold=False)
+    psr = Pulsar(0.005, 0.5, GaussProfile(width=0.05), name="T", seed=7)
+    sig._tobs = make_quant(tobs, "s")
+    tscope = _telescope()
+    cfg, profiles, noise_norm = build_single_config(
+        sig, psr, tscope, "TestSys", null_frac=null_frac
+    )
+    return sig, psr, tscope, cfg, profiles, noise_norm
+
+
+class TestSinglePipeline:
+    def test_shapes_and_finite(self):
+        _, _, _, cfg, profiles, noise_norm = _search_setup()
+        out = np.asarray(
+            single_pipeline(jax.random.key(0), 10.0, noise_norm, profiles, cfg)
+        )
+        assert out.shape == (2, cfg.nsamp)
+        assert np.all(np.isfinite(out))
+        assert cfg.nsub == 10
+        assert cfg.nph == 1024
+
+    def test_statistics_match_oo_path(self):
+        """Same distributions as make_pulses(fold=False) + disperse +
+        radiometer noise (reference chain pulsar.py:222-244 ->
+        ism.py:40-74 -> receiver.py:140-172)."""
+        sig, psr, tscope, cfg, profiles, noise_norm = _search_setup()
+        out = np.asarray(
+            single_pipeline(jax.random.key(3), 10.0, noise_norm, profiles, cfg)
+        )
+
+        sig2 = FilterBankSignal(1400, 400, Nsubband=2, sample_rate=0.2048,
+                                fold=False)
+        psr2 = Pulsar(0.005, 0.5, GaussProfile(width=0.05), name="T", seed=11)
+        psr2.make_pulses(sig2, tobs=0.05)
+        ISM().disperse(sig2, 10.0)
+        rcvr, _ = tscope.systems["TestSys"]
+        rcvr.radiometer_noise(sig2, psr2, gain=tscope.gain, Tsys=35.0)
+        oo = np.asarray(sig2.data)
+
+        assert out.shape == oo.shape
+        assert out.mean() == pytest.approx(oo.mean(), rel=0.1)
+        assert out.std() == pytest.approx(oo.std(), rel=0.15)
+
+    def test_nulling_removes_pulse_energy(self):
+        """With nulling on and noise off, the nulled pulses carry only
+        off-pulse-level power (reference: pulsar.py:246-333)."""
+        _, _, _, cfg, profiles, _ = _search_setup(null_frac=0.5)
+        assert cfg.n_null == 5
+        out = np.asarray(
+            single_pipeline(jax.random.key(1), 0.0, 0.0, profiles, cfg)
+        )
+        shift = cfg.nph // 2 - cfg.peak_bin
+        # per-pulse energy in channel 0, pulse windows aligned to the peak
+        energies = []
+        for p in range(cfg.nsub):
+            lo = p * cfg.nph + shift
+            hi = lo + cfg.nph
+            if lo < 0 or hi > cfg.nsamp:
+                continue
+            energies.append(out[0, lo:hi].sum())
+        energies = np.sort(np.asarray(energies))
+        # the nulled half is far below the live half
+        live, nulled = energies[-3:], energies[:3]
+        assert nulled.mean() < 0.1 * live.mean()
+
+    def test_nulling_replacement_is_row_broadcast(self):
+        """The replacement noise is ONE row broadcast across channels,
+        matching the reference's row-broadcast assignment (pulsar.py:304):
+        nulled pulse windows are (near-)identical across channels while live
+        windows carry independent per-channel draws."""
+        _, _, _, cfg, profiles, _ = _search_setup(null_frac=0.5)
+        out = np.asarray(
+            single_pipeline(jax.random.key(2), 0.0, 0.0, profiles, cfg)
+        )
+        shift = cfg.nph // 2 - cfg.peak_bin
+        diffs = []
+        for p in range(cfg.nsub):
+            lo, hi = p * cfg.nph + shift, (p + 1) * cfg.nph + shift
+            if lo < 0 or hi > cfg.nsamp:
+                continue
+            diffs.append(np.abs(out[0, lo:hi] - out[1, lo:hi]).max())
+        diffs = np.sort(np.asarray(diffs))
+        # ~half the windows are nulled: cross-channel difference there is
+        # FFT float noise only, orders of magnitude below the live windows'
+        # independent on-pulse draws
+        assert diffs[2] < 1e-3 * diffs[-3]
+
+    def test_nulling_zero_fraction_noop_config(self):
+        _, _, _, cfg, _, _ = _search_setup(null_frac=0.0)
+        assert cfg.n_null == 0
+
+    def test_rejects_fold_mode_signal(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=2, sample_rate=0.2048,
+                               sublen=0.5, fold=True)
+        psr = Pulsar(0.005, 0.5, GaussProfile(), name="T")
+        sig._tobs = make_quant(1.0, "s")
+        with pytest.raises(ValueError, match="fold=False"):
+            build_single_config(sig, psr, _telescope(), "TestSys")
+
+    def test_rejects_fractional_sampling(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=2, sample_rate=0.2048,
+                               fold=False)
+        psr = Pulsar(0.0051234, 0.5, GaussProfile(), name="T")
+        sig._tobs = make_quant(0.05, "s")
+        with pytest.raises(ValueError, match="integral"):
+            build_single_config(sig, psr, _telescope(), "TestSys")
+
+
+class TestBasebandPipeline:
+    def _setup(self, tobs=0.02):
+        sig = BasebandSignal(1400, 200, sample_rate=0.2048)
+        psr = Pulsar(0.005, 0.5, GaussProfile(width=0.05), name="T", seed=5)
+        sig._tobs = make_quant(tobs, "s")
+        cfg, sqrt_profiles, noise_norm = build_baseband_config(sig, psr)
+        return sig, psr, cfg, sqrt_profiles, noise_norm
+
+    def test_shapes_and_finite(self):
+        _, _, cfg, sqrt_profiles, _ = self._setup()
+        out = np.asarray(
+            baseband_pipeline(jax.random.key(0), 10.0, 0.0, sqrt_profiles, cfg)
+        )
+        assert out.shape == (2, cfg.nsamp)
+        assert np.all(np.isfinite(out))
+
+    def test_statistics_match_oo_path(self):
+        """Amplitude synthesis + coherent dedispersion vs the OO chain
+        (reference pulsar.py:153-183 + ism.py:76-98)."""
+        _, _, cfg, sqrt_profiles, _ = self._setup()
+        out = np.asarray(
+            baseband_pipeline(jax.random.key(2), 10.0, 0.0, sqrt_profiles, cfg)
+        )
+
+        sig2 = BasebandSignal(1400, 200, sample_rate=0.2048)
+        psr2 = Pulsar(0.005, 0.5, GaussProfile(width=0.05), name="T", seed=9)
+        psr2.make_pulses(sig2, tobs=0.02)
+        ISM().disperse(sig2, 10.0)
+        oo = np.asarray(sig2.data)
+
+        assert out.shape == oo.shape
+        # zero-mean amplitude signals: compare power
+        assert out.std() == pytest.approx(oo.std(), rel=0.1)
+        assert abs(out.mean()) < 0.05 * out.std()
+
+    def test_coherent_dedispersion_preserves_power(self):
+        """The transfer function is pure phase: total power is conserved
+        through the in-graph dispersion (Parseval)."""
+        _, _, cfg, sqrt_profiles, _ = self._setup()
+        k = jax.random.key(4)
+        out0 = np.asarray(baseband_pipeline(k, 0.0, 0.0, sqrt_profiles, cfg))
+        out1 = np.asarray(baseband_pipeline(k, 30.0, 0.0, sqrt_profiles, cfg))
+        assert np.sum(out1**2) == pytest.approx(np.sum(out0**2), rel=1e-3)
+        # and the dispersed stream differs from the undispersed one
+        assert not np.allclose(out0, out1)
+
+
+class TestComposedDelays:
+    def _fold_setup(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=4, sample_rate=0.2048,
+                               sublen=0.5, fold=True)
+        psr = Pulsar(0.005, 2.0, GaussProfile(width=0.05), name="T", seed=3)
+        sig._tobs = make_quant(1.0, "s")
+        tscope = _telescope()
+        return build_fold_config(sig, psr, tscope, "TestSys")
+
+    def test_fd_delay_helper_matches_oo_fd_shift(self):
+        sig = FilterBankSignal(1400, 400, Nsubband=4, sample_rate=0.2048,
+                               sublen=0.5, fold=True)
+        psr = Pulsar(0.005, 2.0, GaussProfile(width=0.05), name="T", seed=3)
+        psr.make_pulses(sig, tobs=1.0)
+        fd = [2e-4, -3e-4]
+        ISM().FD_shift(sig, fd)
+        expect = fd_delays_ms(sig.dat_freq.to("MHz").value, fd)
+        np.testing.assert_allclose(sig.delay.to("ms").value, expect,
+                                   rtol=1e-12)
+
+    def test_scatter_delay_helper_matches_scaling_law(self):
+        freqs = np.array([1200.0, 1400.0, 1600.0])
+        got = scatter_delays_ms(freqs, 1e-6, 1400.0)
+        ism = ISM()
+        expect = np.asarray(
+            ism.scale_tau_d(make_quant(1e-6, "s").to("ms"),
+                            make_quant(1400.0, "MHz"),
+                            make_quant(freqs, "MHz")).value
+        )
+        np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+    def test_extra_delays_compose_into_single_shift(self):
+        """fold_pipeline(extra_delays) == shift(fold_pipeline(no extra)):
+        delays compose additively through the one batched FFT."""
+        from psrsigsim_tpu.ops.shift import fourier_shift
+
+        cfg, profiles, noise_norm = self._fold_setup()
+        extra = fd_delays_ms(cfg.meta.dat_freq_mhz(), [3e-4, -1e-4])
+        k = jax.random.key(6)
+        combined = np.asarray(
+            fold_pipeline(k, 0.0, 0.0, profiles, cfg,
+                          extra_delays_ms=np.asarray(extra, np.float32))
+        )
+        base = fold_pipeline(k, 0.0, 0.0, profiles, cfg)
+        sequential = np.asarray(fourier_shift(base, extra, dt=cfg.dt_ms))
+        np.testing.assert_allclose(combined, sequential, atol=2e-3)
