@@ -1,7 +1,7 @@
 """Mesh sharding and ensemble parallelism (TPU-native; the reference has no
 parallel layer — SURVEY.md §2.1)."""
 
-from .ensemble import FoldEnsemble
+from .ensemble import FoldEnsemble, MultiPulsarFoldEnsemble
 from .mesh import (
     CHAN_AXIS,
     OBS_AXIS,
@@ -14,6 +14,7 @@ from .mesh import (
 
 __all__ = [
     "FoldEnsemble",
+    "MultiPulsarFoldEnsemble",
     "make_mesh",
     "batch_sharding",
     "replicated_sharding",

@@ -11,6 +11,7 @@ from .pipeline import (
     build_single_config,
     fold_pipeline,
     fold_pipeline_batch,
+    fold_pipeline_hetero,
     single_pipeline,
 )
 from .simulate import Simulation
@@ -19,6 +20,7 @@ __all__ = [
     "Simulation",
     "fold_pipeline",
     "fold_pipeline_batch",
+    "fold_pipeline_hetero",
     "build_fold_config",
     "FoldPipelineConfig",
     "single_pipeline",

@@ -124,6 +124,15 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
         ``(Nchan, nsub*Nph)`` float32 block (unclipped — clipping belongs to
         the export path, see ``clip_max``).
     """
+    return _fold_core(key, dm, noise_norm, cfg.nfold, cfg.draw_norm,
+                      cfg.noise_df, profiles, cfg, freqs, chan_ids,
+                      extra_delays_ms)
+
+
+def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
+               freqs, chan_ids, extra_delays_ms):
+    """Shared fold-mode observation body (synthesis + dispersion + noise);
+    pulsar parameters may be static (homogeneous path) or traced (hetero)."""
     kp = stage_key(key, "pulse")
     kn = stage_key(key, "noise")
     if freqs is None:
@@ -135,14 +144,34 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
 
     # pulse synthesis (reference: pulsar.py:196-221)
     block = jnp.tile(profiles, (1, cfg.nsub))
-    block = block * _chan_chi2(kp, chan_ids, cfg.nfold, nsamp) * cfg.draw_norm
+    block = block * _chan_chi2(kp, chan_ids, nfold, nsamp) * draw_norm
 
     # dispersion (+ FD/scatter) as ONE batched shift (reference ism.py:40-74)
     delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
     block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
 
     # radiometer noise (reference: receiver.py:140-172)
-    return block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * noise_norm
+    return block + _chan_chi2(kn, chan_ids, noise_df, nsamp) * noise_norm
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def fold_pipeline_hetero(key, dm, noise_norm, nfold, draw_norm, profiles, cfg,
+                         freqs=None, chan_ids=None, extra_delays_ms=None):
+    """Fold-mode observation with PER-OBSERVATION pulsar parameters traced:
+    portrait, DM, chi2 df (``nfold = sublen/period``), draw norm, noise norm
+    and channel frequencies are all inputs, so observations of DIFFERENT
+    pulsars that share static geometry ``(Nchan, Nph, nsub, dt)`` run
+    through ONE compiled program (the nph-bucketing strategy of
+    :class:`~psrsigsim_tpu.parallel.MultiPulsarFoldEnsemble`).
+
+    In fold mode the radiometer-noise chi2 df equals ``nfold``
+    (reference: receiver.py:163-164), so it is traced here too.
+
+    Args: as :func:`fold_pipeline` plus traced ``nfold``/``draw_norm``.
+    Returns ``(Nchan, nsub*Nph)`` float32.
+    """
+    return _fold_core(key, dm, noise_norm, nfold, draw_norm, nfold, profiles,
+                      cfg, freqs, chan_ids, extra_delays_ms)
 
 
 def fold_pipeline_batch(cfg, shared_profiles=True):

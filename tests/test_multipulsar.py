@@ -1,0 +1,136 @@
+"""Tests for the multi-pulsar fold ensemble: nph-bucketing over
+heterogeneous periods/portraits, per-pulsar DM/noise, and mesh-shape
+invariance (BASELINE config 5; reference per-obs semantics
+pulsar/pulsar.py:196-221)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from psrsigsim_tpu.parallel import MultiPulsarFoldEnsemble, make_mesh
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.signal import FilterBankSignal
+from psrsigsim_tpu.simulate import build_fold_config
+from psrsigsim_tpu.telescope import Backend, Receiver, Telescope
+from psrsigsim_tpu.utils import make_quant
+
+
+def _workload(period_s, dm, width=0.05, nchan=8, smean=0.5):
+    """One pulsar's prepared fold workload; nph = period * 0.2048 MHz."""
+    sig = FilterBankSignal(1400, 400, Nsubband=nchan, sample_rate=0.2048,
+                           sublen=0.5, fold=True)
+    psr = Pulsar(period_s, smean, GaussProfile(width=width), name="T")
+    sig._tobs = make_quant(1.0, "s")
+    t = Telescope(20.0, area=5500.0, Tsys=35.0, name="S")
+    t.add_system("sys", Receiver(fcent=1400, bandwidth=400, name="R"),
+                 Backend(samprate=0.2048, name="B"))
+    cfg, profiles, noise_norm = build_fold_config(sig, psr, t, "sys")
+    return (cfg, profiles, noise_norm, dm)
+
+
+@pytest.fixture(scope="module")
+def workloads():
+    # two nph buckets: period 5 ms -> nph 1024, period 10 ms -> nph 2048;
+    # distinct widths, DMs and fluxes throughout
+    return [
+        _workload(0.005, 10.0, width=0.03, smean=0.4),
+        _workload(0.005, 25.0, width=0.06, smean=0.8),
+        _workload(0.010, 40.0, width=0.04, smean=0.6),
+        _workload(0.005, 55.0, width=0.08, smean=1.2),
+        _workload(0.010, 70.0, width=0.05, smean=0.2),
+    ]
+
+
+class TestMultiPulsarEnsemble:
+    def test_buckets_and_shapes(self, workloads):
+        ens = MultiPulsarFoldEnsemble(workloads, mesh=make_mesh((8, 1)))
+        assert ens.n_buckets == 2
+        out = ens.run(epochs=3, seed=0)
+        assert len(out) == 5
+        # nph differs between buckets: 1024 vs 2048 phase bins, nsub=2
+        assert out[0].shape == (3, 8, 2 * 1024)
+        assert out[2].shape == (3, 8, 2 * 2048)
+        for arr in out:
+            assert np.all(np.isfinite(np.asarray(arr)))
+
+    def test_pulsars_are_distinct(self, workloads):
+        ens = MultiPulsarFoldEnsemble(workloads, mesh=make_mesh((8, 1)))
+        out = ens.run(epochs=2, seed=0)
+        # same bucket, different pulsars: different portraits + draws
+        a, b = np.asarray(out[0]), np.asarray(out[1])
+        assert not np.allclose(a, b)
+
+        # with noise off, the folded mean profiles carry each pulsar's own
+        # width: pulsar 1 (width 0.06) shows more bins above half-max than
+        # pulsar 0 (width 0.03)
+        quiet = [(cfg, prof, 0.0, dm) for cfg, prof, _, dm in workloads]
+        ens_q = MultiPulsarFoldEnsemble(quiet, mesh=make_mesh((8, 1)))
+        out_q = ens_q.run(epochs=2, seed=0)
+        widths = []
+        for arr in (np.asarray(out_q[0]), np.asarray(out_q[1])):
+            prof = arr.mean(axis=(0, 1)).reshape(2, -1).mean(0)
+            widths.append(np.sum(prof > (prof.min() + prof.max()) / 2))
+        assert widths[1] > widths[0]
+
+    def test_mesh_invariance(self, workloads):
+        """Bit-identical results on (8,1), (4,2) and (1,1) meshes."""
+        outs = {}
+        for shape in [(8, 1), (4, 2), (1, 1)]:
+            devs = jax.devices()[: shape[0] * shape[1]]
+            ens = MultiPulsarFoldEnsemble(
+                workloads, mesh=make_mesh(shape, devices=devs)
+            )
+            outs[shape] = [np.asarray(a) for a in ens.run(epochs=2, seed=3)]
+        for i in range(len(workloads)):
+            np.testing.assert_array_equal(outs[(8, 1)][i], outs[(4, 2)][i])
+            np.testing.assert_array_equal(outs[(8, 1)][i], outs[(1, 1)][i])
+
+    def test_epoch_keys_deterministic(self, workloads):
+        ens = MultiPulsarFoldEnsemble(workloads, mesh=make_mesh((8, 1)))
+        o1 = ens.run(epochs=2, seed=5)
+        o2 = ens.run(epochs=2, seed=5)
+        np.testing.assert_array_equal(np.asarray(o1[3]), np.asarray(o2[3]))
+        o3 = ens.run(epochs=2, seed=6)
+        assert not np.allclose(np.asarray(o1[3]), np.asarray(o3[3]))
+
+    def test_statistics_match_single_pulsar_pipeline(self, workloads):
+        """A pulsar simulated through the hetero program matches the
+        homogeneous fold_pipeline's statistics."""
+        from psrsigsim_tpu.simulate import fold_pipeline
+
+        cfg, profiles, noise_norm, dm = workloads[1]
+        ens = MultiPulsarFoldEnsemble(workloads, mesh=make_mesh((8, 1)))
+        out = np.asarray(ens.run(epochs=4, seed=1)[1])
+
+        ref = np.stack([
+            np.asarray(fold_pipeline(jax.random.key(100 + i), dm, noise_norm,
+                                     np.asarray(profiles), cfg))
+            for i in range(4)
+        ])
+        assert out.mean() == pytest.approx(ref.mean(), rel=0.05)
+        assert out.std() == pytest.approx(ref.std(), rel=0.1)
+
+    def test_from_simulations(self):
+        from psrsigsim_tpu.simulate import Simulation
+
+        def simdict(period, dm):
+            return {
+                "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+                "Nchan": 8, "sublen": 0.5, "fold": True, "period": period,
+                "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+                "name": "J0000+0000", "dm": dm, "aperture": 100.0,
+                "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+                "system_name": "sys", "rcvr_fcent": 1400, "rcvr_bw": 400,
+                "rcvr_name": "R", "backend_samprate": 12.5,
+                "backend_name": "B", "seed": 0,
+            }
+
+        sims = [Simulation(psrdict=simdict(0.005, 10.0)),
+                Simulation(psrdict=simdict(0.010, 30.0))]
+        ens = MultiPulsarFoldEnsemble.from_simulations(
+            sims, mesh=make_mesh((8, 1))
+        )
+        out = ens.run(epochs=2, seed=0)
+        assert out[0].shape[2] == 2 * 1024
+        assert out[1].shape[2] == 2 * 2048
