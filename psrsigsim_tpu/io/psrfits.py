@@ -206,20 +206,39 @@ class PSRFITS(BaseFile):
     # -- the save path ------------------------------------------------------
     def save(self, signal, pulsar, parfile=None, MJD_start=56000.0,
              segLength=60.0, inc_len=0.0, ref_MJD=56000.0, usePint=True,
-             eq_wts=True):
+             eq_wts=True, quantized=None):
         """Save the signal to disk as PSRFITS (reference:
-        io/psrfits.py:305-424).  See that docstring for parameter meanings."""
+        io/psrfits.py:305-424).  See that docstring for parameter meanings.
+
+        ``quantized``: optional ``(data, scl, offs)`` triple from the
+        device-side export kernel (:func:`psrsigsim_tpu.ops.subint_quantize`
+        or :meth:`~psrsigsim_tpu.parallel.FoldEnsemble.run_quantized` for
+        one observation) — ``data`` is ``(nsub, Nchan, nbin)`` int16 and
+        ``scl``/``offs`` are ``(nsub, Nchan)``.  The file then carries REAL
+        per-(subint, channel) DAT_SCL/DAT_OFFS columns instead of the
+        reference's raw cast + 1/0 reset (io/psrfits.py:353,386-388);
+        ``eq_wts`` still controls DAT_WTS.
+        """
         if inc_len == 0.0:
             inc_len = MJD_start - ref_MJD
 
         if self.obs_mode != "SEARCH":
             self.nsblk = 1
 
-        stop = self.nbin * self.nsubint
-        sim_sig = np.asarray(signal.data)[:, :stop].astype(">i2")
-        out = np.zeros((self.nsubint, self.npol, self.nchan, self.nbin))
-        for ii in range(self.nsubint):
-            out[ii, 0, :, :] = sim_sig[:, ii * self.nbin : (ii + 1) * self.nbin]
+        if quantized is not None:
+            q_data, q_scl, q_offs = (np.asarray(a) for a in quantized)
+            expect = (self.nsubint, self.nchan, self.nbin)
+            if q_data.shape != expect:
+                raise ValueError(
+                    f"quantized data shape {q_data.shape} != {expect}"
+                )
+            out = q_data.astype(">i2")[:, None, :, :]
+        else:
+            stop = self.nbin * self.nsubint
+            sim_sig = np.asarray(signal.data)[:, :stop].astype(">i2")
+            out = np.zeros((self.nsubint, self.npol, self.nchan, self.nbin))
+            for ii in range(self.nsubint):
+                out[ii, 0, :, :] = sim_sig[:, ii * self.nbin : (ii + 1) * self.nbin]
 
         self.copy_psrfit_BinTables()
 
@@ -231,7 +250,14 @@ class PSRFITS(BaseFile):
             row["DATA"] = out[ii, 0, :, :]
             row["DAT_FREQ"] = dat_freq
             qq = min(ii, template_rows - 1)
-            if eq_wts:
+            if quantized is not None:
+                row["DAT_SCL"] = np.repeat(q_scl[ii], self.npol)
+                row["DAT_OFFS"] = np.repeat(q_offs[ii], self.npol)
+                row["DAT_WTS"] = (
+                    1.0 if eq_wts
+                    else _fit_row(template_sub.data["DAT_WTS"][qq], self.nchan)
+                )
+            elif eq_wts:
                 row["DAT_SCL"] = 1.0
                 row["DAT_OFFS"] = 0.0
                 row["DAT_WTS"] = 1.0

@@ -7,6 +7,7 @@ units) live in the model layer above.
 
 from .convolve import convolve_profiles, fft_convolve_full
 from .interp import PchipCoeffs, pchip_eval, pchip_fit, pchip_slopes
+from .quantize import clip_cast, subint_dequantize, subint_quantize
 from .resample import block_downsample, rebin
 from .shift import (
     coherent_dedisperse,
@@ -34,6 +35,9 @@ __all__ = [
     "chi2_draw_norm",
     "block_downsample",
     "rebin",
+    "clip_cast",
+    "subint_quantize",
+    "subint_dequantize",
     "fft_convolve_full",
     "convolve_profiles",
     "fold_periods",

@@ -1,0 +1,86 @@
+"""Device-side quantization / export kernels.
+
+The reference's export chain is host-side and lossy-by-reset: the observe()
+clip (psrsigsim/telescope/telescope.py:141-145) truncates to the signal
+dtype once on a gathered array, and the PSRFITS writer casts float data
+straight to big-endian int16 while *resetting* DAT_SCL/DAT_OFFS to 1/0
+(psrsigsim/io/psrfits.py:353,386-388) — so any value outside int16 range is
+silently wrapped and the scale columns carry no information.
+
+Here the export path is in-graph (the last stage of the jitted pipeline, so
+ensembles ship quantized bytes off-device — 2-4x less device->host traffic):
+
+- :func:`clip_cast` — reference-parity intensity export: clip from above at
+  the draw ceiling, truncate-cast to the target integer dtype.
+- :func:`subint_quantize` — PSRFITS-grade scaling: per (subint, channel)
+  affine quantization to int16 with real DAT_SCL/DAT_OFFS columns, i.e.
+  ``physical = DATA * DAT_SCL + DAT_OFFS``.
+- :func:`subint_dequantize` — the inverse, for round-trip verification and
+  file reads.
+
+All kernels are pure elementwise/reduction ops on the trailing axes: under
+an (obs x chan) shard_map they need no collectives, and results are
+bit-identical for any mesh shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["clip_cast", "subint_quantize", "subint_dequantize"]
+
+# int16 span used for DAT_SCL scaling: map [lo, hi] onto [-32767, 32767]
+# symmetrically (one code of headroom at the bottom, matching common
+# psrfits-tool practice so -32768 never appears)
+_I16_HALF_SPAN = 32767.0
+
+
+def clip_cast(block, clip_max, dtype=jnp.int8):
+    """Reference-parity intensity export: clip from above at ``clip_max``
+    (the signal's ``_draw_max`` ceiling — reference telescope.py:141-144
+    clips only above for power signals) and truncate-cast, matching
+    ``np.array(out, dtype=...)`` C-style float->int conversion.
+
+    The dynamic-range *scale* is already in the data: int8 signals draw
+    pre-scaled by ``draw_norm`` (reference fb_signal.py:114-121), so
+    clip + cast completes the export.
+    """
+    return jnp.minimum(block, jnp.asarray(clip_max, block.dtype)).astype(dtype)
+
+
+def subint_quantize(block, nsub, nbin):
+    """Quantize one observation ``(Nchan, nsub*nbin)`` to PSRFITS int16
+    subints with real per-(subint, channel) scales and offsets.
+
+    Returns ``(data, scl, offs)``:
+
+    - ``data``: ``(nsub, Nchan, nbin)`` int16,
+    - ``scl``/``offs``: ``(nsub, Nchan)`` float32, with
+      ``physical ≈ data * scl + offs`` exact to half a code.
+
+    Each (subint, channel) row maps its [min, max] onto [-32767, 32767]
+    around the midpoint; constant rows get scl=1, data=0.  Pure per-row
+    reductions — shard-invariant under channel sharding.
+    """
+    nchan = block.shape[0]
+    d3 = block.reshape(nchan, nsub, nbin).transpose(1, 0, 2)  # (nsub, C, nbin)
+    lo = d3.min(axis=-1)
+    hi = d3.max(axis=-1)
+    span = hi - lo
+    scl = jnp.where(span > 0, span / (2.0 * _I16_HALF_SPAN), 1.0)
+    offs = (hi + lo) * 0.5
+    # quantize by an EXPLICIT reciprocal multiply, not `x / scl`: a nested
+    # division invites XLA's algebraic simplifier to rewrite it differently
+    # per compiled program (mesh shape), flipping codes at rounding
+    # boundaries — this sequence is the same IEEE ops in every program, so
+    # the bytes are bit-identical for any mesh shape
+    inv_scl = jnp.where(span > 0, (2.0 * _I16_HALF_SPAN) / span, 1.0)
+    q = jnp.round((d3 - offs[..., None]) * inv_scl[..., None])
+    q = jnp.clip(q, -_I16_HALF_SPAN, _I16_HALF_SPAN).astype(jnp.int16)
+    return q, scl.astype(jnp.float32), offs.astype(jnp.float32)
+
+
+def subint_dequantize(data, scl, offs):
+    """Inverse of :func:`subint_quantize`: ``(nsub, Nchan, nbin)`` int16 +
+    per-row scale/offset back to float32 physical values."""
+    return data.astype(jnp.float32) * scl[..., None] + offs[..., None]

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..ops.quantize import subint_quantize
 from ..simulate.pipeline import (
     build_fold_config,
     fold_pipeline,
@@ -93,14 +94,40 @@ class FoldEnsemble:
             )
         )
 
-    def run(self, n_obs, seed=0, dms=None, noise_norms=None):
-        """Simulate ``n_obs`` observations; returns ``(n_obs, Nchan, Nsamp)``
-        sharded over the mesh.
+        def _local_quantized(keys, dms, norms, profiles, freqs, chan_ids):
+            # same pipeline, then in-graph per-(subint, channel) int16
+            # quantization — the export leaves the device as quarter-size
+            # bytes plus real DAT_SCL/DAT_OFFS columns.  Per-row reductions
+            # only, so the channel shard needs no collectives and the bytes
+            # are identical for any mesh shape.
+            blocks = _local(keys, dms, norms, profiles, freqs, chan_ids)
+            return jax.vmap(
+                lambda b: subint_quantize(b, cfg.nsub, cfg.nph)
+            )(blocks)
 
-        The batch is padded up to a multiple of the obs-axis size and trimmed
-        after, so any ``n_obs`` works.  Per-observation keys derive from
-        ``seed`` by fold-in: results are identical for any mesh shape.
-        """
+        self._run_sharded_quantized = jax.jit(
+            shard_map(
+                _local_quantized,
+                mesh=mesh,
+                in_specs=(
+                    P(OBS_AXIS),
+                    P(OBS_AXIS),
+                    P(OBS_AXIS),
+                    P(CHAN_AXIS, None),
+                    P(CHAN_AXIS),
+                    P(CHAN_AXIS),
+                ),
+                out_specs=(
+                    P(OBS_AXIS, None, CHAN_AXIS, None),
+                    P(OBS_AXIS, None, CHAN_AXIS),
+                    P(OBS_AXIS, None, CHAN_AXIS),
+                ),
+            )
+        )
+
+    def _prep_inputs(self, n_obs, seed, dms, noise_norms):
+        """Per-observation keys/DMs/norms, padded to the obs-shard count and
+        placed with the obs sharding.  Returns ``(keys, dms, norms, pad)``."""
         root = jax.random.key(seed)
         keys = jax.vmap(lambda i: stage_key(root, "user", i))(jnp.arange(n_obs))
         dms = (
@@ -127,11 +154,47 @@ class FoldEnsemble:
         keys = jax.device_put(keys, obs_sharding)
         dms = jax.device_put(dms, obs_sharding)
         norms = jax.device_put(norms, obs_sharding)
+        return keys, dms, norms, pad
 
+    def run(self, n_obs, seed=0, dms=None, noise_norms=None):
+        """Simulate ``n_obs`` observations; returns ``(n_obs, Nchan, Nsamp)``
+        sharded over the mesh.
+
+        The batch is padded up to a multiple of the obs-axis size and trimmed
+        after, so any ``n_obs`` works.  Per-observation keys derive from
+        ``seed`` by fold-in: results are identical for any mesh shape.
+        """
+        keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
         out = self._run_sharded(
             keys, dms, norms, self._profiles, self._freqs, self._chan_ids
         )
         return out[:n_obs] if pad else out
+
+    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None):
+        """Simulate ``n_obs`` observations and quantize ON DEVICE to PSRFITS
+        int16 subints (:func:`~psrsigsim_tpu.ops.subint_quantize`).
+
+        Returns ``(data, scl, offs)``: ``(n_obs, nsub, Nchan, nbin)`` int16
+        plus ``(n_obs, nsub, Nchan)`` float32 scale/offset columns, with
+        ``physical ≈ data * scl + offs``.  Feed one observation's triple to
+        :meth:`psrsigsim_tpu.io.PSRFITS.save` via ``quantized=`` for an
+        export with real DAT_SCL/DAT_OFFS (the reference resets them to 1/0,
+        psrsigsim/io/psrfits.py:386-388).
+
+        Reproducibility: the quantizer adds no mesh dependence (host
+        quantization of the float output reproduces the device bytes
+        exactly).  The bytes are therefore bit-identical wherever the float
+        path is; some backends' FFTs move a last ulp when a deep channel
+        split changes the local batch width, which can flip rare codes by
+        ±1 (see tests/test_quantize.py).
+        """
+        keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
+        data, scl, offs = self._run_sharded_quantized(
+            keys, dms, norms, self._profiles, self._freqs, self._chan_ids
+        )
+        if pad:
+            data, scl, offs = data[:n_obs], scl[:n_obs], offs[:n_obs]
+        return data, scl, offs
 
     def folded_profiles(self, data):
         """Reduce an ensemble block to per-observation folded pulse profiles
