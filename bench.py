@@ -400,6 +400,50 @@ def time_tpu_ensemble(sim, dm):
     return dt
 
 
+def time_io_encode(nchan=2048, nsub=20, nbin=2048):
+    """Host-side PSRFITS subint encode (float32 -> '>i2' relayout) and pdv
+    text formatting: C++ fast path vs the pure-Python fallback."""
+    from psrsigsim_tpu.io import native
+
+    if not native.available():
+        return {"native_available": False}
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(0, 50, (nchan, nsub * nbin)).astype(np.float32)
+
+    t0 = time.perf_counter()
+    native.encode_subints(data, nsub, nbin)
+    t_nat = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    sim = data.astype(">i2")
+    out = np.zeros((nsub, 1, nchan, nbin))
+    for ii in range(nsub):
+        out[ii, 0, :, :] = sim[:, ii * nbin : (ii + 1) * nbin]
+    t_py = time.perf_counter() - t0
+
+    row = data[0, :nbin]
+    t0 = time.perf_counter()
+    for _ in range(64):
+        native.format_pdv_block(row, 0, 0)
+    t_pdv_nat = (time.perf_counter() - t0) / 64
+
+    t0 = time.perf_counter()
+    for _ in range(4):
+        "".join("%s %s %s %s \n" % (0, 0, bb, row[bb]) for bb in range(nbin))
+    t_pdv_py = (time.perf_counter() - t0) / 4
+
+    return {
+        "native_available": True,
+        "subint_encode_native_s": round(t_nat, 5),
+        "subint_encode_python_s": round(t_py, 5),
+        "subint_encode_speedup": round(t_py / t_nat, 2),
+        "pdv_format_native_s_per_chan": round(t_pdv_nat, 6),
+        "pdv_format_python_s_per_chan": round(t_pdv_py, 6),
+        "pdv_format_speedup": round(t_pdv_py / t_pdv_nat, 2),
+    }
+
+
 def main():
     # keep stdout clean for the single JSON result line: the OO layer's
     # reference-parity warnings (sub-Nyquist sampling etc.) print to stdout
@@ -501,6 +545,10 @@ def _main():
     detail["config5_multipulsar"] = mp
     log(f"config5_multipulsar: device {mp['tpu_obs_per_sec']:.1f} obs/s vs "
         f"cpu {1/mp['cpu_s_per_obs']:.2f} obs/s -> {mp['speedup']:.1f}x")
+
+    # --- host-side IO encode: native C++ vs pure Python -----------------
+    detail["io_encode"] = time_io_encode()
+    log(f"io_encode: native {detail['io_encode']}")
     detail["total_bench_s"] = round(time.perf_counter() - t_start, 1)
 
     return {

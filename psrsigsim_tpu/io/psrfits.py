@@ -20,6 +20,7 @@ import numpy as np
 from ..signal import FilterBankSignal
 from ..utils.quantity import make_quant
 from ..utils.utils import make_par
+from . import native
 from .file import BaseFile
 from .fits import Card, FitsFile, Header, bintable_dtype
 from .polyco import generate_polyco
@@ -233,6 +234,13 @@ class PSRFITS(BaseFile):
                     f"quantized data shape {q_data.shape} != {expect}"
                 )
             out = q_data.astype(">i2")[:, None, :, :]
+        elif (native.available() and self.npol == 1
+                and np.asarray(signal.data).dtype == np.float32):
+            # C++ fast path: one pass over the float payload doing the
+            # truncation cast + byteswap + per-subint relayout
+            out = native.encode_subints(
+                np.asarray(signal.data), self.nsubint, self.nbin
+            )
         else:
             stop = self.nbin * self.nsubint
             sim_sig = np.asarray(signal.data)[:, :stop].astype(">i2")
@@ -251,8 +259,10 @@ class PSRFITS(BaseFile):
             row["DAT_FREQ"] = dat_freq
             qq = min(ii, template_rows - 1)
             if quantized is not None:
-                row["DAT_SCL"] = np.repeat(q_scl[ii], self.npol)
-                row["DAT_OFFS"] = np.repeat(q_offs[ii], self.npol)
+                # DAT_SCL/DAT_OFFS are pol-major: all channels of pol 0,
+                # then pol 1, ... (matching _fit_row's nchan*npol layout)
+                row["DAT_SCL"] = np.tile(q_scl[ii], self.npol)
+                row["DAT_OFFS"] = np.tile(q_offs[ii], self.npol)
                 row["DAT_WTS"] = (
                     1.0 if eq_wts
                     else _fit_row(template_sub.data["DAT_WTS"][qq], self.nchan)

@@ -6,6 +6,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..utils.quantity import make_quant
+from . import native
 from .file import BaseFile
 
 __all__ = ["TxtFile"]
@@ -58,6 +59,8 @@ class TxtFile(BaseFile):
 
         dump_val = 0
         file_num = 0
+        use_native = (native.available() and data.dtype == np.float32
+                      and data.shape[1] >= self.nbin)
         for ii in range(self.nrows):
             mjd_mid = 56000.0 + (ii + 1) * (self.tsubint.to("day").value) / 2.0
             for ff in range(self.nchan):
@@ -68,8 +71,16 @@ class TxtFile(BaseFile):
                        self.obsbw.value / self.nchan)
                 )
                 row = data[ff]
-                for bb in range(self.nbin):
-                    lines.append("%s %s %s %s \n" % (ii, ff, bb, row[bb]))
+                if use_native:
+                    # C++ formatter, byte-identical to the loop below
+                    lines.append(
+                        native.format_pdv_block(
+                            row[: self.nbin], ii, ff
+                        ).decode("ascii")
+                    )
+                else:
+                    for bb in range(self.nbin):
+                        lines.append("%s %s %s %s \n" % (ii, ff, bb, row[bb]))
                 dump_val += 1
             if dump_val >= 100:
                 file_num += 1
