@@ -8,7 +8,10 @@ callers fall back to the pure-Python implementations when the toolchain
 is unavailable, and tests assert byte parity between the two paths.
 
 Public surface:
-    available()               -> bool
+    available()               -> bool (library compiled + loaded)
+    encode_available()        -> bool (available and int16-cast parity with
+                                 numpy verified on this host, incl. NaN and
+                                 out-of-range values)
     encode_subints(data, nsub, nbin, npol=1) -> (nsub, npol, nchan, nbin) '>i2'
     format_pdv_block(row, isub, ichan)       -> bytes (pdv text lines)
 """
@@ -22,7 +25,8 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "encode_subints", "format_pdv_block"]
+__all__ = ["available", "encode_available", "encode_subints",
+           "format_pdv_block"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "encode.cpp")
@@ -82,6 +86,30 @@ def _load():
 def available():
     """True when the native library compiled and loaded on this host."""
     return _load() is not None
+
+
+_cast_ok = None
+
+
+def encode_available():
+    """True when the native int16 encode is byte-identical to numpy's
+    float32 -> '>i2' cast on this host.  Out-of-range and NaN conversion is
+    ISA-dependent (x86 cvttss2si vs ARM saturating fcvtzs), so parity is
+    probed at load time rather than assumed."""
+    global _cast_ok
+    if not available():
+        return False
+    if _cast_ok is None:
+        probe = np.array(
+            [[3e9, -3e9, np.nan, 2.2e9, -2.2e9, 65000.0, -65000.0,
+              1.9, -1.9, 200.7, -200.7, 0.0]],
+            dtype=np.float32,
+        )
+        with np.errstate(invalid="ignore"):
+            expect = probe.astype(">i2")
+        got = encode_subints(probe, 1, probe.shape[1])[0, 0]
+        _cast_ok = bool(np.array_equal(got, expect))
+    return _cast_ok
 
 
 def encode_subints(data, nsub, nbin, npol=1):

@@ -22,7 +22,67 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["parse_par", "generate_polyco", "polyco_phase"]
+__all__ = ["parse_par", "generate_polyco", "polyco_phase",
+           "UnsupportedTimingModelError", "check_par_supported"]
+
+
+class UnsupportedTimingModelError(ValueError):
+    """The par file carries timing-model terms the closed-form spin polyco
+    cannot honor (binary orbit, proper motion/parallax, F2+, glitches,
+    topocentric reference site).  The reference handles these through a
+    PINT/TEMPO fit (reference: io/psrfits.py:144-177); here they must be
+    rejected rather than silently ignored."""
+
+
+# binary-orbit terms (any binary model)
+_BINARY_TERMS = frozenset({
+    "BINARY", "PB", "A1", "T0", "OM", "ECC", "E", "SINI", "M2", "TASC",
+    "EPS1", "EPS2", "PBDOT", "OMDOT", "XDOT", "EDOT", "GAMMA", "MTOT",
+    "KOM", "KIN", "SHAPMAX", "H3", "H4", "STIG",
+})
+# astrometric motion terms (position alone is fine at a barycentric site)
+_ASTROMETRY_TERMS = frozenset({
+    "PMRA", "PMDEC", "PMLAMBDA", "PMBETA", "PMELONG", "PMELAT", "PX",
+})
+# time-variable dispersion (shifts absolute phase at REF_FREQ over time)
+_DM_VAR_PREFIXES = ("DMX", "DM1", "DM2", "DM3")
+# glitches and orbital-frequency series
+_EVENT_PREFIXES = ("GLEP_", "GLPH_", "GLF0", "GLF1", "GLF2", "FB")
+
+
+def check_par_supported(params, parfile="<par>"):
+    """Raise :class:`UnsupportedTimingModelError` if ``params`` (a
+    :func:`parse_par` dict) holds terms the closed-form polyco ignores.
+
+    The closed form honors exactly: F0, F1, PEPOCH, TZRFRQ, TZRMJD and a
+    barycentric TZRSITE ('@'); sky position, DM, and fit metadata are
+    allowed because they do not enter the barycentric spin phase.
+    """
+    bad = []
+    for key, val in params.items():
+        offending = (
+            key in _BINARY_TERMS
+            or key in _ASTROMETRY_TERMS
+            or key.startswith(_EVENT_PREFIXES)
+            or key.startswith(_DM_VAR_PREFIXES)
+            or (key.startswith("F") and key[1:].isdigit()
+                and int(key[1:]) >= 2)
+        )
+        # zero-valued numeric terms have no effect on the phase model
+        # (make_par writes PMLAMBDA/PMBETA/PX 0.0 defaults, mirroring the
+        # reference's utils/utils.py:369-371)
+        if offending and not (isinstance(val, float) and val == 0.0):
+            bad.append(key)
+    site = str(params.get("TZRSITE", "@")).strip()
+    if site not in ("@", "0", "bat", "BAT"):
+        bad.append(f"TZRSITE={site}")
+    if bad:
+        raise UnsupportedTimingModelError(
+            f"par file {parfile} contains timing-model terms the "
+            f"closed-form polyco cannot honor: {sorted(set(bad))}. "
+            "Generate polycos with PINT/TEMPO externally, or pass "
+            "strict=False to knowingly ignore them."
+        )
 
 
 def parse_par(parfile):
@@ -49,7 +109,8 @@ def parse_par(parfile):
     return params
 
 
-def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15):
+def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
+                    strict=True):
     """Closed-form polyco for an isolated spin model (F0 [, F1]).
 
     Args:
@@ -58,6 +119,11 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15):
         MJD_start: start MJD of the span.
         segLength: span length in minutes (NSPAN).
         ncoeff: number of coefficients (NCOEF); extras are zero.
+        strict: when True (default), raise
+            :class:`UnsupportedTimingModelError` if the par file carries
+            binary/astrometric-motion/F2+/glitch/DM-variation terms or a
+            topocentric TZRSITE — the closed form would silently mispredict
+            phase for those models.  ``strict=False`` ignores them.
 
     Returns:
         dict with the keys the PSRFITS POLYCO table wants: NSPAN, NCOEF,
@@ -65,6 +131,8 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15):
         reference's polyco_dict (io/psrfits.py:144-177).
     """
     m = parse_par(parfile)
+    if strict:
+        check_par_supported(m, parfile=parfile)
     if "F0" in m:
         f0 = float(m["F0"])
     elif "F" in m:

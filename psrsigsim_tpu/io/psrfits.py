@@ -103,19 +103,21 @@ class PSRFITS(BaseFile):
 
     # -- polyco + metadata --------------------------------------------------
     def _gen_polyco(self, parfile, MJD_start, segLength=60.0, ncoeff=15,
-                    maxha=12.0, method="TEMPO", numNodes=20, usePINT=True):
+                    maxha=12.0, method="TEMPO", numNodes=20, usePINT=True,
+                    strict=True):
         """Polyco parameters for the POLYCO HDU.
 
         Signature mirrors the reference (io/psrfits.py:116-143); generation
         is closed-form for the isolated spin model (see io/polyco.py) rather
         than a PINT TEMPO fit.  ``usePINT=False`` raises, as upstream.
+        ``strict=False`` skips the unsupported-timing-model gate.
         """
         if not usePINT:
             raise NotImplementedError(
                 "Only the PINT-equivalent path is supported for polycos"
             )
         return generate_polyco(parfile, MJD_start, segLength=segLength,
-                               ncoeff=ncoeff)
+                               ncoeff=ncoeff, strict=strict)
 
     def _gen_metadata(self, signal, pulsar, ref_MJD=56000.0, inc_len=0.0):
         """PRIMARY/SUBINT phase-connection numbers: OFFS_SUB per subint and
@@ -207,7 +209,7 @@ class PSRFITS(BaseFile):
     # -- the save path ------------------------------------------------------
     def save(self, signal, pulsar, parfile=None, MJD_start=56000.0,
              segLength=60.0, inc_len=0.0, ref_MJD=56000.0, usePint=True,
-             eq_wts=True, quantized=None):
+             eq_wts=True, quantized=None, strict_polyco=True):
         """Save the signal to disk as PSRFITS (reference:
         io/psrfits.py:305-424).  See that docstring for parameter meanings.
 
@@ -234,8 +236,9 @@ class PSRFITS(BaseFile):
                     f"quantized data shape {q_data.shape} != {expect}"
                 )
             out = q_data.astype(">i2")[:, None, :, :]
-        elif (native.available() and self.npol == 1
-                and np.asarray(signal.data).dtype == np.float32):
+        elif (native.encode_available() and self.npol == 1
+                and np.asarray(signal.data).dtype == np.float32
+                and np.asarray(signal.data).shape[0] == self.nchan):
             # C++ fast path: one pass over the float payload doing the
             # truncation cast + byteswap + per-subint relayout
             out = native.encode_subints(
@@ -290,7 +293,7 @@ class PSRFITS(BaseFile):
 
         polyco_dict = self._gen_polyco(parfile, MJD_start,
                                        segLength=segLength, ncoeff=15,
-                                       usePINT=usePint)
+                                       usePINT=usePint, strict=strict_polyco)
         primary_dict, subint_dict = self._gen_metadata(
             signal, pulsar, ref_MJD=ref_MJD, inc_len=inc_len
         )

@@ -21,10 +21,9 @@ from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
 from psrsigsim_tpu.signal import FilterBankSignal
 from psrsigsim_tpu.utils import make_par
 
-# vendored golden fixture (repo data/, mirroring the reference's data/)
-TEMPLATE = os.path.join(
-    os.path.dirname(__file__), "..", "data", "B1855+09.L-wide.PUPPI.11y.x.sum.sm"
-)
+# vendored golden fixtures (repo data/, mirroring the reference's data/)
+DATA_DIR = os.path.join(os.path.dirname(__file__), "..", "data")
+TEMPLATE = os.path.join(DATA_DIR, "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
 
 # loud failure, never a skip: a standalone checkout must always exercise
 # the IO suite against the real NANOGrav template
@@ -139,6 +138,49 @@ class TestPolyco:
         # MJD float64 quantization floors phase precision at ~1e-4 cycles
         # (eps(56000 days) ~ 0.6 us); TEMPO's polyco format shares this
         assert dphi == pytest.approx(1.0, abs=3e-4)
+
+    def test_rejects_unsupported_timing_model(self):
+        # the vendored NANOGrav par carries astrometric motion (PMLAMBDA,
+        # PX), DMX epochs, and binary terms — the closed-form polyco must
+        # fail loudly rather than mispredict phase (VERDICT item 10)
+        from psrsigsim_tpu.io.polyco import UnsupportedTimingModelError
+
+        par = os.path.join(DATA_DIR, "J1910+1256_NANOGrav_11yv1.gls.par")
+        with pytest.raises(UnsupportedTimingModelError) as err:
+            generate_polyco(par, 55999.9861)
+        msg = str(err.value)
+        assert "PX" in msg and "PMLAMBDA" in msg
+
+    def test_strict_false_ignores_unsupported_terms(self):
+        par = os.path.join(DATA_DIR, "J1910+1256_NANOGrav_11yv1.gls.par")
+        pc = generate_polyco(par, 55999.9861, strict=False)
+        assert pc["REF_F0"] == pytest.approx(200.6588053032901939)
+
+    def test_rejects_unsupported_terms_individually(self, tmp_path):
+        from psrsigsim_tpu.io.polyco import UnsupportedTimingModelError
+
+        base, _ = self._write_par(tmp_path)
+        base_text = open(base).read()
+        for extra in ("F2 1e-20", "BINARY DD", "PB 67.8", "PMRA -0.78",
+                      "GLEP_1 55000", "DMX_0001 1e-3"):
+            par = str(tmp_path / "bad.par")
+            with open(par, "w") as f:
+                f.write(base_text + extra + "\n")
+            with pytest.raises(UnsupportedTimingModelError):
+                generate_polyco(par, 55999.9861)
+
+    def test_rejects_topocentric_site(self, tmp_path):
+        from psrsigsim_tpu.io.polyco import UnsupportedTimingModelError
+
+        base, _ = self._write_par(tmp_path)
+        import re
+
+        text = re.sub(r"TZRSITE\s+@", "TZRSITE GB", open(base).read())
+        par = str(tmp_path / "topo.par")
+        with open(par, "w") as f:
+            f.write(text)
+        with pytest.raises(UnsupportedTimingModelError):
+            generate_polyco(par, 55999.9861)
 
 
 def _simulated(seed=51):
