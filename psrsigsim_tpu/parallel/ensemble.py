@@ -128,32 +128,15 @@ class FoldEnsemble:
     def _prep_inputs(self, n_obs, seed, dms, noise_norms):
         """Per-observation keys/DMs/norms, padded to the obs-shard count and
         placed with the obs sharding.  Returns ``(keys, dms, norms, pad)``."""
-        root = jax.random.key(seed)
-        keys = jax.vmap(lambda i: stage_key(root, "user", i))(jnp.arange(n_obs))
-        dms = (
-            jnp.full(n_obs, self.dm, jnp.float32)
-            if dms is None
-            else jnp.asarray(dms, jnp.float32)
-        )
-        norms = (
-            jnp.full(n_obs, self.noise_norm, jnp.float32)
-            if noise_norms is None
-            else jnp.asarray(noise_norms, jnp.float32)
-        )
-        if dms.shape != (n_obs,) or norms.shape != (n_obs,):
+        if dms is not None and np.shape(dms) != (n_obs,):
             raise ValueError("dms/noise_norms must have shape (n_obs,)")
-
+        if noise_norms is not None and np.shape(noise_norms) != (n_obs,):
+            raise ValueError("dms/noise_norms must have shape (n_obs,)")
         n_obs_shards = self.mesh.shape[OBS_AXIS]
         pad = (-n_obs) % n_obs_shards
-        if pad:
-            # tile modulo n_obs so any pad size works (even pad > n_obs)
-            idx = jnp.arange(n_obs + pad) % n_obs
-            keys, dms, norms = keys[idx], dms[idx], norms[idx]
-
-        obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
-        keys = jax.device_put(keys, obs_sharding)
-        dms = jax.device_put(dms, obs_sharding)
-        norms = jax.device_put(norms, obs_sharding)
+        # tile modulo n_obs so any pad size works (even pad > n_obs)
+        idx = np.arange(n_obs + pad) % n_obs
+        keys, dms, norms = self._prep_chunk(idx, seed, dms, noise_norms)
         return keys, dms, norms, pad
 
     def run(self, n_obs, seed=0, dms=None, noise_norms=None):
@@ -195,6 +178,82 @@ class FoldEnsemble:
         if pad:
             data, scl, offs = data[:n_obs], scl[:n_obs], offs[:n_obs]
         return data, scl, offs
+
+    def _prep_chunk(self, idx, seed, dms_full, norms_full):
+        """Inputs for the global observation indices ``idx`` (already padded
+        to a fixed chunk length), placed with the obs sharding."""
+        root = jax.random.key(seed)
+        idx = jnp.asarray(idx)
+        keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx)
+        dms = (
+            jnp.full(idx.shape, self.dm, jnp.float32)
+            if dms_full is None
+            else jnp.asarray(dms_full, jnp.float32)[idx]
+        )
+        norms = (
+            jnp.full(idx.shape, self.noise_norm, jnp.float32)
+            if norms_full is None
+            else jnp.asarray(norms_full, jnp.float32)[idx]
+        )
+        obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
+        return (jax.device_put(keys, obs_sharding),
+                jax.device_put(dms, obs_sharding),
+                jax.device_put(norms, obs_sharding))
+
+    def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
+                    noise_norms=None, quantized=False, progress=None):
+        """Stream a large ensemble in fixed-size chunks.
+
+        Yields ``(start, block)`` with ``block`` a host-materialized
+        ``(count, Nchan, Nsamp)`` array (or a ``(data, scl, offs)`` triple
+        when ``quantized=True``) for observations ``start..start+count``.
+        Every chunk runs the same compiled program (``chunk_size`` rounds up
+        to the obs-shard count; the tail is padded by wrapping indices and
+        trimmed), and PRNG keys derive from GLOBAL observation indices — so
+        draws are identical to one-shot :meth:`run` with the same ``seed``.
+        Chunk sizes that map to the same padded program width are
+        bit-identical to each other; against a one-shot run of a different
+        batch width the backend FFT may move a last ulp (same caveat as
+        :meth:`run_quantized`).
+
+        ``progress``: optional callable ``progress(done, total)`` invoked
+        after each chunk (e.g. :class:`psrsigsim_tpu.utils.ConsoleProgress`)
+        — the user-visible signal for 10k-observation runs, standing in for
+        the reference's per-channel percent printout (ism/ism.py:62-74).
+        """
+        if dms is not None and np.shape(dms) != (n_obs,):
+            raise ValueError("dms must have shape (n_obs,)")
+        if noise_norms is not None and np.shape(noise_norms) != (n_obs,):
+            raise ValueError("noise_norms must have shape (n_obs,)")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be positive")
+        if n_obs <= 0:
+            return
+        chunk_size = min(chunk_size, n_obs)
+        n_obs_shards = self.mesh.shape[OBS_AXIS]
+        chunk_size += (-chunk_size) % n_obs_shards
+
+        for start in range(0, n_obs, chunk_size):
+            count = min(chunk_size, n_obs - start)
+            idx = (start + np.arange(chunk_size)) % n_obs
+            keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
+                                                    noise_norms)
+            if quantized:
+                d, s, o = self._run_sharded_quantized(
+                    keys, dms_c, norms_c, self._profiles, self._freqs,
+                    self._chan_ids,
+                )
+                block = (np.asarray(d[:count]), np.asarray(s[:count]),
+                         np.asarray(o[:count]))
+            else:
+                out = self._run_sharded(
+                    keys, dms_c, norms_c, self._profiles, self._freqs,
+                    self._chan_ids,
+                )
+                block = np.asarray(out[:count])
+            if progress is not None:
+                progress(min(start + count, n_obs), n_obs)
+            yield start, block
 
     def folded_profiles(self, data):
         """Reduce an ensemble block to per-observation folded pulse profiles

@@ -2,6 +2,7 @@
 and host-side numerics (reference layer: psrsigsim/utils/)."""
 
 from .constants import DM_K, DM_K_MS_MHZ2, KB_JY_M2_PER_K, KOLMOGOROV_BETA
+from .progress import ConsoleProgress
 from .quantity import Quantity, Unit, UnitConversionError, make_quant
 from .rng import KeySequence, next_key, set_seed, stage_key
 from .utils import (
@@ -17,6 +18,7 @@ from .utils import (
 )
 
 __all__ = [
+    "ConsoleProgress",
     "make_quant",
     "Quantity",
     "Unit",
