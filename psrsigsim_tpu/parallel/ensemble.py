@@ -125,13 +125,17 @@ class FoldEnsemble:
             )
         )
 
+    @staticmethod
+    def _validate_per_obs(n_obs, dms, noise_norms):
+        if dms is not None and np.shape(dms) != (n_obs,):
+            raise ValueError(f"dms must have shape ({n_obs},)")
+        if noise_norms is not None and np.shape(noise_norms) != (n_obs,):
+            raise ValueError(f"noise_norms must have shape ({n_obs},)")
+
     def _prep_inputs(self, n_obs, seed, dms, noise_norms):
         """Per-observation keys/DMs/norms, padded to the obs-shard count and
         placed with the obs sharding.  Returns ``(keys, dms, norms, pad)``."""
-        if dms is not None and np.shape(dms) != (n_obs,):
-            raise ValueError("dms/noise_norms must have shape (n_obs,)")
-        if noise_norms is not None and np.shape(noise_norms) != (n_obs,):
-            raise ValueError("dms/noise_norms must have shape (n_obs,)")
+        self._validate_per_obs(n_obs, dms, noise_norms)
         n_obs_shards = self.mesh.shape[OBS_AXIS]
         pad = (-n_obs) % n_obs_shards
         # tile modulo n_obs so any pad size works (even pad > n_obs)
@@ -221,10 +225,7 @@ class FoldEnsemble:
         — the user-visible signal for 10k-observation runs, standing in for
         the reference's per-channel percent printout (ism/ism.py:62-74).
         """
-        if dms is not None and np.shape(dms) != (n_obs,):
-            raise ValueError("dms must have shape (n_obs,)")
-        if noise_norms is not None and np.shape(noise_norms) != (n_obs,):
-            raise ValueError("noise_norms must have shape (n_obs,)")
+        self._validate_per_obs(n_obs, dms, noise_norms)
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
         if n_obs <= 0:
