@@ -2,6 +2,13 @@
 parallel layer — SURVEY.md §2.1)."""
 
 from .ensemble import FoldEnsemble, MultiPulsarFoldEnsemble
+from .seqshard import (
+    SEQ_AXIS,
+    SEQ_RNG_BLOCK,
+    blocked_chan_chi2,
+    make_seq_mesh,
+    seq_sharded_search,
+)
 from .mesh import (
     CHAN_AXIS,
     OBS_AXIS,
@@ -22,4 +29,9 @@ __all__ = [
     "distributed_init",
     "OBS_AXIS",
     "CHAN_AXIS",
+    "SEQ_AXIS",
+    "SEQ_RNG_BLOCK",
+    "make_seq_mesh",
+    "seq_sharded_search",
+    "blocked_chan_chi2",
 ]

@@ -1,0 +1,201 @@
+"""Sequence (time-axis) parallelism for SEARCH-mode streams.
+
+The reference's long axis is time: single-pulse mode generates "a large
+amount of data" (reference: signal/fb_signal.py:53) and marks the missing
+chunking with a TODO (reference: pulsar.py:171,235).  SURVEY §5 calls the
+``Nsamp`` axis this domain's analog of context parallelism; this module
+makes it first-class, the all-to-all (Ulysses-style) way:
+
+* **Time-sharded stages** — pulse synthesis, nulling masks, radiometer
+  noise are elementwise in time, so each device owns a ``(Nchan, T/n)``
+  slab of the stream.  Random draws are keyed by
+  ``(stage, channel, RNG block)`` where a block is a fixed
+  ``SEQ_RNG_BLOCK``-sample span of GLOBAL time — so the drawn stream is
+  bit-identical for ANY number of sequence shards.
+* **The one sequence-global op** — the dispersion/FD/scatter Fourier
+  shift needs the full time axis.  Rather than a distributed FFT, the
+  block transposes: ``all_to_all`` re-shards channels and gathers time
+  (``(Nchan, T/n) -> (Nchan/n, T)``), the exact batched shift runs
+  locally per channel slab, and a second ``all_to_all`` transposes back.
+  Two collectives per observation, both riding ICI, and the FFT itself
+  stays a dense local XLA op.
+
+This composes with the ``(obs, chan)`` ensemble sharding: ensembles
+parallelize many observations; sequence sharding parallelizes ONE
+observation too long for a single device's HBM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.shift import fourier_shift
+from ..ops.stats import chi2_sample
+from ..simulate.pipeline import _dispersion_delays, _null_mask_row
+from ..utils.rng import stage_key
+
+try:  # jax >= 0.6 stable API, else the experimental home
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["SEQ_AXIS", "SEQ_RNG_BLOCK", "make_seq_mesh",
+           "seq_sharded_search", "blocked_chan_chi2"]
+
+SEQ_AXIS = "seq"
+
+# Fixed span of global time samples per RNG key. Must not depend on the
+# mesh, or draws would change with the shard count.
+SEQ_RNG_BLOCK = 16384
+
+
+def make_seq_mesh(n_devices=None, devices=None):
+    """1-D ``('seq',)`` mesh over ``n_devices`` (default: all visible).
+
+    Raises if fewer than ``n_devices`` devices exist — a silently smaller
+    mesh would change sharding and divisibility requirements behind the
+    caller's back (mirroring ``make_mesh``'s strictness).
+    """
+    if devices is not None:
+        if n_devices is not None and len(devices) != n_devices:
+            raise ValueError(
+                f"got {len(devices)} explicit devices but n_devices="
+                f"{n_devices}; pass one or the other"
+            )
+    else:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"requested a {n_devices}-device seq mesh but only "
+                    f"{len(devices)} devices are visible"
+                )
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SEQ_AXIS,))
+
+
+def blocked_chan_chi2(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK):
+    """Per-channel chi2 draws for global time span ``[t0, t0+length)``,
+    keyed by ``(channel, global block index)``.
+
+    Each shard draws the whole RNG blocks covering its slab and slices its
+    span out, so the assembled stream is bit-identical for any sharding of
+    the time axis (the ≤1-block overdraw at each edge is the price).
+    ``length`` and ``block`` are static; ``t0`` may be traced.
+    """
+    nblk = (length + block - 1) // block + 1  # covers any t0 alignment
+    b0 = t0 // block
+
+    def per_chan(c):
+        ck = jax.random.fold_in(key, c)
+        blocks = jax.vmap(
+            lambda b: chi2_sample(jax.random.fold_in(ck, b), df, (block,))
+        )(b0 + jnp.arange(nblk))
+        return lax.dynamic_slice(blocks.reshape(-1), (t0 - b0 * block,),
+                                 (length,))
+
+    return jax.vmap(per_chan)(chan_ids)
+
+
+
+
+def seq_sharded_search(cfg, mesh=None):
+    """Compile the SEARCH-mode pipeline with the time axis sharded over
+    ``mesh``'s ``'seq'`` axis.
+
+    Semantics mirror :func:`~psrsigsim_tpu.simulate.single_pipeline`
+    (synthesis → in-graph nulling → dispersion shift → radiometer noise;
+    reference chain pulsar.py:222-333, ism.py:40-74, receiver.py:140-172)
+    with one difference: random draws are block-keyed (see
+    :func:`blocked_chan_chi2`) instead of one stream per channel, so the
+    two pipelines agree in distribution but not sample-for-sample.  Within
+    this pipeline, results are bit-identical for ANY sequence shard count
+    (tests/test_seqshard.py).
+
+    Requires ``cfg.nsamp`` and ``cfg.meta.nchan`` divisible by the shard
+    count.  Returns ``run(key, dm, noise_norm, profiles) -> (Nchan, nsamp)``
+    jitted and sharded ``P(None, 'seq')``.
+    """
+    if mesh is None:
+        mesh = make_seq_mesh()
+    n = mesh.shape[SEQ_AXIS]
+    nchan = cfg.meta.nchan
+    nsamp = cfg.nsamp
+    if nsamp % n:
+        raise ValueError(f"nsamp={nsamp} must be divisible by the seq axis ({n})")
+    if nchan % n:
+        raise ValueError(f"Nchan={nchan} must be divisible by the seq axis ({n})")
+    if nsamp >= 2**31:
+        # global time indices / RNG block ids are int32 in-graph
+        raise ValueError(
+            f"nsamp={nsamp} exceeds int32 indexing; split the observation "
+            "into sub-spans (one program per span) instead"
+        )
+    L = nsamp // n
+    freqs_full = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float32)
+
+    def _local(key, dm, noise_norm, profiles, extra_delays_ms):
+        # profiles (Nchan, nph) replicated; this shard owns global time
+        # span [t0, t0 + L)
+        shard = lax.axis_index(SEQ_AXIS)
+        t0 = shard * L
+        kp = stage_key(key, "pulse")
+        kn = stage_key(key, "noise")
+        chan_ids = jnp.arange(nchan)
+
+        # synthesis: portrait value at each global sample phase x chi2(1)
+        idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
+        block = jnp.take(profiles, idx, axis=1)
+        block = block * blocked_chan_chi2(kp, chan_ids, 1.0, t0, L) \
+            * cfg.draw_norm
+
+        # nulling: shared global-index mask (one source of truth with
+        # single_pipeline); same keys on every shard
+        if cfg.n_null > 0:
+            knz = stage_key(key, "null_noise")
+            mask_row = _null_mask_row(key, cfg, t0, L)
+            # one replacement-noise row broadcast to all channels
+            # (reference: pulsar.py:304), keyed by pseudo-channel id
+            # ``nchan`` to stay clear of real channel streams
+            repl_row = blocked_chan_chi2(
+                knz, jnp.asarray([nchan]), cfg.null_df, t0, L
+            )[0] * cfg.draw_norm * cfg.off_pulse_mean
+            block = jnp.where(mask_row[None, :], repl_row[None, :], block)
+
+        # transpose: (Nchan, L) -> (Nchan/n, nsamp); exact full-length
+        # Fourier shift per local channel slab; transpose back
+        gathered = lax.all_to_all(block, SEQ_AXIS, 0, 1, tiled=True)
+        my_chans = shard * (nchan // n) + jnp.arange(nchan // n)
+        delays_ms = _dispersion_delays(
+            dm, jnp.asarray(freqs_full)[my_chans], extra_delays_ms[my_chans]
+        )
+        gathered = fourier_shift(gathered, delays_ms, dt=cfg.dt_ms)
+        block = lax.all_to_all(gathered, SEQ_AXIS, 1, 0, tiled=True)
+
+        # radiometer noise (chi2 df=1 in search mode), time-sharded
+        noise = blocked_chan_chi2(kn, chan_ids, cfg.noise_df, t0, L)
+        return block + noise * noise_norm
+
+    sharded = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, None), P(None)),
+        out_specs=P(None, SEQ_AXIS),
+    )
+
+    @jax.jit
+    def run(key, dm, noise_norm, profiles, extra_delays_ms=None):
+        # extra per-channel delays (ms): FD polynomial / scatter shifts,
+        # composed into the same batched Fourier shift exactly as in
+        # single_pipeline (host helpers: models.ism.fd_delays_ms,
+        # models.ism.scatter_delays_ms)
+        if extra_delays_ms is None:
+            extra_delays_ms = jnp.zeros(nchan, jnp.float32)
+        return sharded(key, dm, noise_norm, profiles, extra_delays_ms)
+
+    return run

@@ -92,6 +92,23 @@ def _dispersion_delays(dm, freqs, extra_delays_ms):
     return delays_ms
 
 
+def _null_mask_row(key, cfg, t0, length):
+    """Which of the global time samples ``[t0, t0+length)`` fall inside a
+    nulled pulse (reference: pulsar.py:246-333, reworked as static mask
+    arithmetic).  The same key on every caller -> the nulled pulse set is
+    identical across any time/channel sharding.  Shared by
+    :func:`single_pipeline` and the sequence-parallel pipeline
+    (parallel/seqshard.py) so the nulling semantics cannot drift."""
+    ksel = stage_key(key, "null_select")
+    sel = jax.random.permutation(ksel, cfg.nsub)[: cfg.n_null]
+    nulled = jnp.zeros(cfg.nsub + 1, bool).at[sel].set(True)  # +1: guard row
+    shift_val = cfg.nph // 2 - cfg.peak_bin
+    gidx = t0 + jnp.arange(length, dtype=jnp.int32)
+    pulse_id = (gidx - shift_val) // cfg.nph
+    in_range = (pulse_id >= 0) & (pulse_id < cfg.nsub)
+    return jnp.where(in_range, nulled[jnp.clip(pulse_id, 0, cfg.nsub)], False)
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
                   extra_delays_ms=None):
@@ -311,15 +328,8 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
     # any mesh split, matching the reference's row-broadcast assignment
     # (pulsar.py:304: one noise row written to all channels).
     if cfg.n_null > 0:
-        ksel = stage_key(key, "null_select")
         knz = stage_key(key, "null_noise")
-        sel = jax.random.permutation(ksel, cfg.nsub)[: cfg.n_null]
-        nulled = jnp.zeros(cfg.nsub + 1, bool).at[sel].set(True)  # +1: guard row
-        shift_val = cfg.nph // 2 - cfg.peak_bin
-        pulse_id = (jnp.arange(nsamp, dtype=jnp.int32) - shift_val) // cfg.nph
-        in_range = (pulse_id >= 0) & (pulse_id < cfg.nsub)
-        mask_row = jnp.where(in_range, nulled[jnp.clip(pulse_id, 0, cfg.nsub)],
-                             False)
+        mask_row = _null_mask_row(key, cfg, 0, nsamp)
         repl_row = (
             chi2_sample(knz, cfg.null_df, (nsamp,))
             * cfg.draw_norm
