@@ -1,6 +1,7 @@
 """IO: PSRFITS / pdv data products (reference layer: psrsigsim/io/), backed
 by a from-scratch FITS core and closed-form polycos (no cfitsio/PINT)."""
 
+from .export import export_ensemble_psrfits
 from .file import BaseFile
 from .fits import Card, FitsFile, HDU, Header
 from .polyco import generate_polyco, parse_par, polyco_phase
@@ -8,6 +9,7 @@ from .psrfits import PSRFITS
 from .txtfile import TxtFile
 
 __all__ = [
+    "export_ensemble_psrfits",
     "BaseFile",
     "PSRFITS",
     "TxtFile",

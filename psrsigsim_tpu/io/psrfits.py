@@ -67,7 +67,10 @@ class PSRFITS(BaseFile):
         if template is None:
             raise ValueError("PSRFITS currently requires a template file "
                              "(fits_mode='copy', matching the reference)")
-        self.fits_template = FitsFile.read(template)
+        # accept a preloaded FitsFile so bulk exporters don't re-read the
+        # template once per output file (drafts always copy, never mutate it)
+        self.fits_template = (template if isinstance(template, FitsFile)
+                              else FitsFile.read(template))
         self.draft_hdr_keys = self.fits_template.names()
 
         # editable copies: headers + table record arrays
@@ -126,8 +129,13 @@ class PSRFITS(BaseFile):
         subint_dict = {"EPOCHS": "MIDTIME"}
         primary_dict = {}
 
-        sublen = float(signal.sublen.to("s").value)
-        offs_sub = sublen / 2.0 + np.arange(signal.nsub) * sublen
+        # row cadence: subintegration length in PSR mode, NSBLK*TBIN in
+        # SEARCH mode (where rows are raw time blocks, not folds)
+        if self.obs_mode == "SEARCH":
+            sublen = float(self.tsubint.to("s").value)
+        else:
+            sublen = float(signal.sublen.to("s").value)
+        offs_sub = sublen / 2.0 + np.arange(self.nsubint) * sublen
         subint_dict["OFFS_SUB"] = offs_sub
 
         # split the reference MJD into integer day / second / fractional
@@ -228,14 +236,28 @@ class PSRFITS(BaseFile):
         if self.obs_mode != "SEARCH":
             self.nsblk = 1
 
+        search = self.obs_mode == "SEARCH"
+        row_len = self.nsblk if search else self.nbin
         if quantized is not None:
             q_data, q_scl, q_offs = (np.asarray(a) for a in quantized)
-            expect = (self.nsubint, self.nchan, self.nbin)
+            expect = (self.nsubint, self.nchan, row_len)
             if q_data.shape != expect:
                 raise ValueError(
                     f"quantized data shape {q_data.shape} != {expect}"
                 )
-            out = q_data.astype(">i2")[:, None, :, :]
+            if search:
+                # row layout (nsblk, npol, nchan)
+                out = q_data.astype(">i2").transpose(0, 2, 1)[:, :, None, :]
+            else:
+                out = q_data.astype(">i2")[:, None, :, :]
+        elif search:
+            # (Nchan, nsamp) -> per-row (nsblk, npol, nchan) time-major
+            stop = row_len * self.nsubint
+            sim_sig = np.asarray(signal.data)[:, :stop].astype(">i2")
+            out = (
+                sim_sig.reshape(self.nchan, self.nsubint, row_len)
+                .transpose(1, 2, 0)[:, :, None, :]
+            )
         elif (native.encode_available() and self.npol == 1
                 and np.asarray(signal.data).dtype == np.float32
                 and np.asarray(signal.data).shape[0] == self.nchan):
@@ -258,7 +280,9 @@ class PSRFITS(BaseFile):
         dat_freq = np.asarray(signal.dat_freq.value, dtype=np.float64)
         for ii in range(self.nsubint):
             row = self.HDU_drafts["SUBINT"][ii]
-            row["DATA"] = out[ii, 0, :, :]
+            # search rows are (nsblk, npol, nchan); PSR rows broadcast the
+            # single-pol (nchan, nbin) block over npol
+            row["DATA"] = out[ii] if search else out[ii, 0, :, :]
             row["DAT_FREQ"] = dat_freq
             qq = min(ii, template_rows - 1)
             if quantized is not None:
@@ -300,7 +324,8 @@ class PSRFITS(BaseFile):
         subint_dict["POL_TYPE"] = "AA+BB"
         subint_dict["CHAN_BW"] = self.chan_bw.value
         subint_dict["TSUBINT"] = np.repeat(self.tsubint.value, self.nsubint)
-        subint_dict["TBIN"] = pulsar.period.value / self.nbin
+        subint_dict["TBIN"] = (float(self.tbin.to("s").value) if search
+                               else pulsar.period.value / self.nbin)
         subint_dict["DM"] = signal.dm.value
         subint_dict["NBIN"] = self.nbin
         self._edit_psrfits_header(polyco_dict, subint_dict, primary_dict)
@@ -381,12 +406,19 @@ class PSRFITS(BaseFile):
 
     def set_subint_dims(self, nbin=1, nsblk=1, nchan=2048, nsubint=1, npol=1):
         """Rebuild the SUBINT draft dtype + header geometry for the simulated
-        dimensions (pdat-equivalent; PSR mode: DATA is (npol, nchan, nbin)
-        int16 with TDIM (nbin, nchan, npol))."""
+        dimensions (pdat-equivalent).
+
+        PSR mode: DATA is (npol, nchan, nbin) int16, TDIM (nbin, nchan, npol).
+        SEARCH mode: each row is NSBLK time samples — DATA is
+        (nsblk, npol, nchan) int16, TDIM (nchan, npol, nsblk), NBIN=1
+        (PSRFITS standard; the reference collects the TDIM17 key for this
+        layout but never writes it, io/psrfits.py:103)."""
         self.nsubint = nsubint
+        search = self.obs_mode == "SEARCH"
         header = self.draft_headers["SUBINT"]
         template_dtype, _ = bintable_dtype(self.fits_template["SUBINT"].header)
 
+        data_shape = (nsblk, npol, nchan) if search else (npol, nchan, nbin)
         fields = []
         for name in template_dtype.names:
             base = template_dtype[name].base
@@ -397,7 +429,7 @@ class PSRFITS(BaseFile):
             elif name in ("DAT_SCL", "DAT_OFFS"):
                 fields.append((name, ">f4", (nchan * npol,)))
             elif name == "DATA":
-                fields.append((name, ">i2", (npol, nchan, nbin)))
+                fields.append((name, ">i2", data_shape))
             else:
                 shape = template_dtype[name].shape
                 fields.append((name, base, shape) if shape else (name, base))
@@ -423,12 +455,17 @@ class PSRFITS(BaseFile):
         _set_col("DAT_WTS", f"{nchan}E")
         _set_col("DAT_SCL", f"{nchan * npol}E")
         _set_col("DAT_OFFS", f"{nchan * npol}E")
-        _set_col("DATA", f"{npol * nchan * nbin}I", f"({nbin},{nchan},{npol})")
+        n_data = int(np.prod(data_shape))
+        tdim = (f"({nchan},{npol},{nsblk})" if search
+                else f"({nbin},{nchan},{npol})")
+        _set_col("DATA", f"{n_data}I", tdim)
         header["NAXIS1"] = self.subint_dtype.itemsize
         header["NAXIS2"] = nsubint
         header["NCHAN"] = nchan
         header["NPOL"] = npol
         header["NBIN"] = nbin
+        if search:
+            header["NBITS"] = 16
         header["NSBLK"] = nsblk
 
     @staticmethod
@@ -466,6 +503,25 @@ class PSRFITS(BaseFile):
             self.stt_imjd = self.pfit_dict["STT_IMJD"]
             self.stt_smjd = self.pfit_dict["STT_SMJD"]
             self.tsubint = self.pfit_dict["TSUBINT"]
+        elif self.obs_mode == "SEARCH":
+            # search-mode geometry: each SUBINT row holds NSBLK time
+            # samples of every (pol, chan), NBIN=1.  The reference never
+            # implemented search-mode writing (its save() reshapes PSR
+            # geometry only and make_signal_from_psrfits carries a TODO,
+            # reference: io/psrfits.py:349-361,444); this completes it.
+            self.nchan = signal.Nchan
+            self.tbin = float((1.0 / signal.samprate).to("s").value)
+            self.nbin = 1
+            self.npol = signal.Npols
+            nsamp = int(signal.nsamp)
+            # largest row length <= 4096 that tiles the stream exactly
+            self.nsblk = max(k for k in range(1, min(4096, nsamp) + 1)
+                             if nsamp % k == 0)
+            self.nrows = nsamp // self.nsblk
+            self.obsfreq = signal.fcent
+            self.obsbw = signal.bw
+            self.chan_bw = signal.bw / signal.Nchan
+            self.tsubint = self.nsblk * float((1.0 / signal.samprate).to("s").value)
         else:
             self.nchan = signal.Nchan
             self.tbin = float((1.0 / signal.samprate).to("s").value)
@@ -478,7 +534,7 @@ class PSRFITS(BaseFile):
             self.chan_bw = signal.bw / signal.Nchan
             self.tsubint = signal.sublen
 
-        self.nsubint = self.nrows if self.obs_mode == "PSR" else None
+        self.nsubint = self.nrows
 
     def _make_psrfits_pars_dict(self):
         """Collect the shopping-list parameters from the template

@@ -52,6 +52,10 @@ class FoldEnsemble:
         self.cfg, profiles_np, self.noise_norm = build_fold_config(
             signal, pulsar, telescope, system, Tsys=Tsys
         )
+        # kept for metadata-only consumers (PSRFITS export); the builder
+        # above has already stamped nsub/nsamp/draw_norm onto it
+        self._signal = signal
+        self._pulsar = pulsar
         self.mesh = mesh if mesh is not None else make_mesh()
         self.dm = float(signal.dm.value) if signal.dm is not None else 0.0
 
@@ -205,7 +209,8 @@ class FoldEnsemble:
                 jax.device_put(norms, obs_sharding))
 
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
-                    noise_norms=None, quantized=False, progress=None):
+                    noise_norms=None, quantized=False, progress=None,
+                    skip_chunk=None):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -224,6 +229,11 @@ class FoldEnsemble:
         after each chunk (e.g. :class:`psrsigsim_tpu.utils.ConsoleProgress`)
         — the user-visible signal for 10k-observation runs, standing in for
         the reference's per-channel percent printout (ism/ism.py:62-74).
+
+        ``skip_chunk``: optional predicate ``skip_chunk(start, count)``;
+        when it returns True the chunk's device computation is skipped
+        entirely and nothing is yielded for it (progress still advances).
+        This is how resuming exporters avoid re-simulating finished work.
         """
         self._validate_per_obs(n_obs, dms, noise_norms)
         if chunk_size <= 0:
@@ -236,6 +246,10 @@ class FoldEnsemble:
 
         for start in range(0, n_obs, chunk_size):
             count = min(chunk_size, n_obs - start)
+            if skip_chunk is not None and skip_chunk(start, count):
+                if progress is not None:
+                    progress(min(start + count, n_obs), n_obs)
+                continue
             idx = (start + np.arange(chunk_size)) % n_obs
             keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
                                                     noise_norms)
@@ -255,6 +269,16 @@ class FoldEnsemble:
             if progress is not None:
                 progress(min(start + count, n_obs), n_obs)
             yield start, block
+
+    def signal_shell(self):
+        """The configured signal object (metadata only — no ensemble data
+        lives on it).  Used by the PSRFITS bulk exporter
+        (:func:`psrsigsim_tpu.io.export_ensemble_psrfits`)."""
+        return self._signal
+
+    @property
+    def pulsar(self):
+        return self._pulsar
 
     def folded_profiles(self, data):
         """Reduce an ensemble block to per-observation folded pulse profiles

@@ -1,0 +1,119 @@
+"""SEARCH-mode PSRFITS writing: NSBLK/TDIM17 geometry, write-and-reread
+parity, and the quantized export from the single-pulse pipeline.
+
+The reference collects the SEARCH keys but its save() only ever builds
+PSR geometry (reference: io/psrfits.py:103,349-361); this framework
+completes the write path (VERDICT item 7)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.io import PSRFITS
+from psrsigsim_tpu.io.fits import FitsFile
+from psrsigsim_tpu.ism import ISM
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.signal import FilterBankSignal
+
+TEMPLATE = os.path.join(
+    os.path.dirname(__file__), "..", "data", "B1855+09.L-wide.PUPPI.11y.x.sum.sm"
+)
+
+
+@pytest.fixture
+def search_signal():
+    sig = FilterBankSignal(1400.0, 400.0, Nsubband=4, sample_rate=0.2048,
+                           fold=False)
+    psr = Pulsar(0.005, 0.05, GaussProfile(width=0.02), name="J0000+0000",
+                 seed=6)
+    psr.make_pulses(sig, tobs=0.1)     # 20 pulses, 20480 samples/chan
+    ISM().disperse(sig, 12.0)
+    return sig, psr
+
+
+def _saved(tmp_path, sig, psr, **kw):
+    out = str(tmp_path / "search.fits")
+    pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="SEARCH")
+    pfit.get_signal_params(signal=sig)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        pfit.save(sig, psr, **kw)
+    finally:
+        os.chdir(cwd)
+    return out, pfit
+
+
+class TestSearchGeometry:
+    def test_dims_from_signal(self, search_signal):
+        sig, _ = search_signal
+        pfit = PSRFITS(path="/tmp/x.fits", template=TEMPLATE,
+                       obs_mode="SEARCH")
+        pfit.get_signal_params(signal=sig)
+        assert pfit.nbin == 1
+        assert int(sig.nsamp) == pfit.nsblk * pfit.nrows
+        assert pfit.nsblk == 4096       # largest row length <= 4096
+        assert float(pfit.tsubint.to("s").value) == pytest.approx(
+            4096 / (0.2048e6)
+        )
+
+    def test_write_and_reread(self, search_signal, tmp_path):
+        sig, psr = search_signal
+        out, pfit = _saved(tmp_path, sig, psr)
+
+        back = FitsFile.read(out)
+        sub = back["SUBINT"]
+        hdr = sub.read_header()
+        assert hdr["NBIN"] == 1
+        assert hdr["NSBLK"] == 4096
+        assert hdr["NBITS"] == 16
+        n_data = str(hdr[f"TDIM{_data_col(sub)}"]).strip()
+        assert n_data == "(4,1,4096)"   # (nchan, npol, nsblk)
+        assert sub.data["DATA"].shape == (pfit.nrows, 4096, 1, 4)
+
+        # value parity: DATA[row, blk, 0, chan] == int16(data[chan, ...])
+        raw = np.asarray(sig.data)[:, : pfit.nrows * 4096].astype(">i2")
+        expect = raw.reshape(4, pfit.nrows, 4096).transpose(1, 2, 0)
+        assert np.array_equal(sub.data["DATA"][:, :, 0, :], expect)
+        # TBIN is the raw sample time in search mode
+        assert hdr["TBIN"] == pytest.approx(1.0 / 0.2048e6)
+
+    def test_quantized_search_export(self, search_signal, tmp_path):
+        sig, psr = search_signal
+        from psrsigsim_tpu.ops.quantize import subint_quantize
+
+        pfit0 = PSRFITS(path="/tmp/x.fits", template=TEMPLATE,
+                        obs_mode="SEARCH")
+        pfit0.get_signal_params(signal=sig)
+        data, scl, offs = (
+            np.asarray(a)
+            for a in subint_quantize(
+                np.asarray(sig.data)[:, : pfit0.nrows * pfit0.nsblk],
+                pfit0.nrows, pfit0.nsblk,
+            )
+        )
+        out, pfit = _saved(tmp_path, sig, psr,
+                           quantized=(data, scl, offs))
+        back = FitsFile.read(out)
+        sub = back["SUBINT"]
+        # stored codes match and scales reconstruct the physical values
+        assert np.array_equal(
+            sub.data["DATA"][:, :, 0, :], data.transpose(0, 2, 1)
+        )
+        got_scl = np.asarray(sub.data["DAT_SCL"])
+        assert np.allclose(got_scl, scl, rtol=1e-6)
+        recon = (sub.data["DATA"][:, :, 0, :].astype(np.float64)
+                 * got_scl[:, None, :]
+                 + np.asarray(sub.data["DAT_OFFS"])[:, None, :])
+        raw = np.asarray(sig.data)[:, : pfit.nrows * pfit.nsblk]
+        expect = raw.reshape(4, pfit.nrows, pfit.nsblk).transpose(1, 2, 0)
+        assert np.allclose(recon, expect, atol=np.abs(scl).max())
+
+
+def _data_col(sub_hdu):
+    hdr = sub_hdu.read_header()
+    for k, v in hdr.items():
+        if k.startswith("TTYPE") and str(v).strip() == "DATA":
+            return int(k[5:])
+    raise AssertionError("no DATA column")
