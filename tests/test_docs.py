@@ -14,7 +14,7 @@ import re
 import pytest
 
 DOCS_DIR = os.path.join(os.path.dirname(__file__), "..", "docs")
-TUTORIALS = sorted(glob.glob(os.path.join(DOCS_DIR, "*.md")))
+TUTORIALS = sorted(glob.glob(os.path.join(DOCS_DIR, "tutorial_*.md")))
 
 _BLOCK_RE = re.compile(r"```python\n(.*?)```", re.DOTALL)
 
