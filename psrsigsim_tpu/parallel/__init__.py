@@ -6,7 +6,11 @@ from .seqshard import (
     SEQ_AXIS,
     SEQ_RNG_BLOCK,
     blocked_chan_chi2,
+    blocked_chan_normal,
+    dispersion_halo_samples,
     make_seq_mesh,
+    seq_sharded_baseband,
+    seq_sharded_dedisperse,
     seq_sharded_search,
 )
 from .mesh import (
@@ -33,5 +37,9 @@ __all__ = [
     "SEQ_RNG_BLOCK",
     "make_seq_mesh",
     "seq_sharded_search",
+    "seq_sharded_baseband",
+    "seq_sharded_dedisperse",
+    "dispersion_halo_samples",
     "blocked_chan_chi2",
+    "blocked_chan_normal",
 ]

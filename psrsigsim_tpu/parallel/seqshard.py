@@ -34,8 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.shift import fourier_shift
-from ..ops.stats import chi2_sample
+from ..ops.shift import coherent_dedisperse, fourier_shift
+from ..ops.stats import chi2_sample, normal_sample
 from ..simulate.pipeline import _dispersion_delays, _null_mask_row
 from ..utils.rng import stage_key
 
@@ -45,7 +45,9 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 __all__ = ["SEQ_AXIS", "SEQ_RNG_BLOCK", "make_seq_mesh",
-           "seq_sharded_search", "blocked_chan_chi2"]
+           "seq_sharded_search", "seq_sharded_baseband",
+           "seq_sharded_dedisperse", "dispersion_halo_samples",
+           "blocked_chan_chi2", "blocked_chan_normal"]
 
 SEQ_AXIS = "seq"
 
@@ -79,9 +81,9 @@ def make_seq_mesh(n_devices=None, devices=None):
     return Mesh(np.asarray(devices), (SEQ_AXIS,))
 
 
-def blocked_chan_chi2(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK):
-    """Per-channel chi2 draws for global time span ``[t0, t0+length)``,
-    keyed by ``(channel, global block index)``.
+def _blocked_chan_draw(sampler, key, chan_ids, t0, length, block):
+    """Per-channel draws for global time span ``[t0, t0+length)``, keyed by
+    ``(channel, global block index)``.
 
     Each shard draws the whole RNG blocks covering its slab and slices its
     span out, so the assembled stream is bit-identical for any sharding of
@@ -94,12 +96,27 @@ def blocked_chan_chi2(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK):
     def per_chan(c):
         ck = jax.random.fold_in(key, c)
         blocks = jax.vmap(
-            lambda b: chi2_sample(jax.random.fold_in(ck, b), df, (block,))
+            lambda b: sampler(jax.random.fold_in(ck, b), (block,))
         )(b0 + jnp.arange(nblk))
         return lax.dynamic_slice(blocks.reshape(-1), (t0 - b0 * block,),
                                  (length,))
 
     return jax.vmap(per_chan)(chan_ids)
+
+
+def blocked_chan_chi2(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK):
+    """Blocked chi-squared draws (see :func:`_blocked_chan_draw`)."""
+    return _blocked_chan_draw(
+        lambda k, shape: chi2_sample(k, df, shape), key, chan_ids, t0,
+        length, block,
+    )
+
+
+def blocked_chan_normal(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK):
+    """Blocked standard-normal draws (see :func:`_blocked_chan_draw`)."""
+    return _blocked_chan_draw(
+        normal_sample, key, chan_ids, t0, length, block,
+    )
 
 
 
@@ -199,3 +216,174 @@ def seq_sharded_search(cfg, mesh=None):
         return sharded(key, dm, noise_norm, profiles, extra_delays_ms)
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Baseband: overlap-save coherent dedispersion with ring halo exchange
+# ---------------------------------------------------------------------------
+
+
+def dispersion_halo_samples(dm, fcent_mhz, bw_mhz, dt_us, margin=4.0):
+    """Samples of dispersion smearing across the band — the halo size the
+    overlap-save blocks need on EACH side.
+
+    The coherent-dispersion impulse response is a two-sided chirp of
+    support ~ the DM sweep across [fcent - bw/2, fcent + bw/2], plus
+    band-edge Fresnel ringing decaying like ~1/lag — so truncation error
+    falls roughly linearly with ``margin`` (measured at margin=4: max
+    ~2.5%, rms ~0.5% of the signal std for a 4 MHz band; double the halo
+    to halve it).  ``margin`` multiplies the sweep.
+    """
+    dm_k_s = 1.0 / 2.41e-4  # s MHz^2 cm^3 / pc
+    f_lo = fcent_mhz - bw_mhz / 2.0
+    f_hi = fcent_mhz + bw_mhz / 2.0
+    # |dm|: negative trial DMs smear just as far, in the other direction
+    sweep_s = dm_k_s * abs(float(dm)) * (f_lo**-2 - f_hi**-2)
+    return int(np.ceil(margin * sweep_s * 1e6 / dt_us)) + 1
+
+
+def seq_sharded_dedisperse(cfg, dm, mesh=None, halo=None):
+    """Coherent dedispersion of a time-sharded baseband stream by
+    overlap-save blocks with a ring halo exchange.
+
+    The full-stream op is one circular FFT filter
+    (:func:`~psrsigsim_tpu.ops.coherent_dedisperse`, reference:
+    ism/ism.py:76-98).  Sharded, each device filters its local slab
+    extended by ``halo`` samples fetched cyclically from BOTH ring
+    neighbors via ``lax.ppermute`` — the classic overlap-save scheme of
+    streaming dedispersion backends, with the cyclic fetch making the
+    result match the reference's CIRCULAR filtering (not just the linear
+    interior) up to the halo truncation of the impulse response.
+
+    Requires ``halo <= nsamp/n`` (the impulse support must fit in one
+    neighbor's slab); wide-band/high-DM configs whose smearing exceeds
+    that need fewer shards or the full-length FFT path.
+
+    Returns ``run(x) -> y`` jitted over the mesh, in/out ``(Npol, nsamp)``
+    sharded ``P(None, 'seq')``.  ``dm`` is static (it sizes the halo).
+    """
+    mesh, n, L = _seq_prologue(cfg, mesh)
+    dedisp = _make_dedisp_local(cfg, dm, n, L, halo)
+
+    return jax.jit(
+        shard_map(
+            dedisp,
+            mesh=mesh,
+            in_specs=P(None, SEQ_AXIS),
+            out_specs=P(None, SEQ_AXIS),
+        )
+    )
+
+
+def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
+    """The baseband pipeline with the time axis sharded: blocked amplitude
+    synthesis (sqrt-profile × N(0,1); reference pulsar.py:153-183),
+    overlap-save coherent dedispersion (:func:`seq_sharded_dedisperse`),
+    and blocked amplitude radiometer noise (reference receiver.py:123-138).
+
+    Draw streams are bit-identical for any shard count (block-keyed RNG);
+    the dedispersed output matches the unsharded
+    :func:`~psrsigsim_tpu.simulate.baseband_pipeline` up to the halo
+    truncation (set ``halo`` larger to tighten).  ``dm`` is static.
+
+    Returns ``run(key, noise_norm, sqrt_profiles) -> (Npol, nsamp)``.
+    """
+    mesh, n, L = _seq_prologue(cfg, mesh)
+    dedisp = _make_dedisp_local(cfg, dm, n, L, halo)
+
+    def _local(key, noise_norm, sqrt_profiles):
+        shard = lax.axis_index(SEQ_AXIS)
+        t0 = shard * L
+        kp = stage_key(key, "pulse")
+        kn = stage_key(key, "noise")
+        npol = sqrt_profiles.shape[0]
+        chan_ids = jnp.arange(npol)
+
+        idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
+        amp = jnp.take(sqrt_profiles, idx, axis=1)
+        block = amp * blocked_chan_normal(kp, chan_ids, t0, L)
+
+        block = dedisp(block)
+
+        noise = blocked_chan_normal(kn, chan_ids, t0, L)
+        return block + noise * noise_norm
+
+    return jax.jit(
+        shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(None, None)),
+            out_specs=P(None, SEQ_AXIS),
+        )
+    )
+
+
+def _seq_prologue(cfg, mesh):
+    """Shared setup for the baseband seq-sharded builders: default mesh,
+    divisibility + int32 guards, slab length."""
+    if mesh is None:
+        mesh = make_seq_mesh()
+    n = mesh.shape[SEQ_AXIS]
+    nsamp = cfg.nsamp
+    if nsamp % n:
+        raise ValueError(f"nsamp={nsamp} must be divisible by the seq axis ({n})")
+    if nsamp >= 2**31:
+        # global time indices / RNG block ids are int32 in-graph
+        raise ValueError(
+            f"nsamp={nsamp} exceeds int32 indexing; split the observation "
+            "into sub-spans (one program per span) instead"
+        )
+    return mesh, n, nsamp // n
+
+
+def _make_dedisp_local(cfg, dm, n, L, halo):
+    """The per-shard overlap-save dedispersion body (shared by the
+    standalone op and the full pipeline).
+
+    The extended block length is rounded UP to a power of two — the TPU
+    backend lowers awkward FFT lengths as a dense DFT matrix (O(B²)
+    memory; fatal) — and the slack all goes into a larger right halo,
+    which only tightens the truncation error at no extra collective cost.
+    """
+    if n == 1:
+        # no neighbors: the full-length circular filter, exactly (no halo
+        # needed, so no smearing limit applies)
+        return lambda x: coherent_dedisperse(
+            x, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us
+        )
+    if halo is None:
+        halo = dispersion_halo_samples(dm, cfg.fcent_mhz, cfg.bw_mhz,
+                                       cfg.dt_us)
+    if halo < 1:
+        # hl = 0 would make x[:, -hl:] the whole slab — silently wrong
+        raise ValueError(f"halo must be >= 1 (got {halo})")
+    if halo > L:
+        raise ValueError(
+            f"dispersion smearing ({halo} samples) exceeds the local slab "
+            f"({L}); use fewer seq shards or the unsharded FFT path"
+        )
+    block = 1 << int(np.ceil(np.log2(L + 2 * halo)))
+    hl = halo
+    hr = block - L - hl
+    if hr > L:
+        # cap the right halo at one neighbor's slab (keeps the fetch
+        # single-hop); pad the remainder into the left halo if it fits
+        hr = L
+        hl = block - L - hr
+        if hl > L:
+            raise ValueError(
+                f"padded overlap-save block ({block}) needs halos beyond "
+                f"one slab ({L}); use fewer seq shards"
+            )
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+    perm_bwd = [(i, (i - 1) % n) for i in range(n)]
+
+    def dedisp(x):
+        left = lax.ppermute(x[:, -hl:], SEQ_AXIS, perm_fwd)
+        right = lax.ppermute(x[:, :hr], SEQ_AXIS, perm_bwd)
+        ext = jnp.concatenate([left, x, right], axis=1)  # (pol, block)
+        y = coherent_dedisperse(ext, dm, cfg.fcent_mhz, cfg.bw_mhz,
+                                cfg.dt_us)
+        return y[:, hl : hl + L]
+
+    return dedisp
