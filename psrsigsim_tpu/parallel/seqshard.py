@@ -138,22 +138,11 @@ def seq_sharded_search(cfg, mesh=None):
     count.  Returns ``run(key, dm, noise_norm, profiles) -> (Nchan, nsamp)``
     jitted and sharded ``P(None, 'seq')``.
     """
-    if mesh is None:
-        mesh = make_seq_mesh()
-    n = mesh.shape[SEQ_AXIS]
+    mesh, n, L = _seq_prologue(cfg, mesh)
     nchan = cfg.meta.nchan
     nsamp = cfg.nsamp
-    if nsamp % n:
-        raise ValueError(f"nsamp={nsamp} must be divisible by the seq axis ({n})")
     if nchan % n:
         raise ValueError(f"Nchan={nchan} must be divisible by the seq axis ({n})")
-    if nsamp >= 2**31:
-        # global time indices / RNG block ids are int32 in-graph
-        raise ValueError(
-            f"nsamp={nsamp} exceeds int32 indexing; split the observation "
-            "into sub-spans (one program per span) instead"
-        )
-    L = nsamp // n
     freqs_full = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float32)
 
     def _local(key, dm, noise_norm, profiles, extra_delays_ms):
@@ -281,10 +270,13 @@ def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
     overlap-save coherent dedispersion (:func:`seq_sharded_dedisperse`),
     and blocked amplitude radiometer noise (reference receiver.py:123-138).
 
-    Draw streams are bit-identical for any shard count (block-keyed RNG);
-    the dedispersed output matches the unsharded
-    :func:`~psrsigsim_tpu.simulate.baseband_pipeline` up to the halo
-    truncation (set ``halo`` larger to tighten).  ``dm`` is static.
+    Draw streams are block-keyed, so like :func:`seq_sharded_search` this
+    agrees with the unsharded
+    :func:`~psrsigsim_tpu.simulate.baseband_pipeline` in DISTRIBUTION, not
+    sample-for-sample.  Within this pipeline, draws are bit-identical for
+    any shard count, and the dedispersion stage matches the exact circular
+    filter on the same input up to the halo truncation
+    (tests/test_seqshard_baseband.py).  ``dm`` is static.
 
     Returns ``run(key, noise_norm, sqrt_profiles) -> (Npol, nsamp)``.
     """

@@ -106,9 +106,29 @@ class Simulation:
             setattr(self, "_" + key, psrdict[key])
 
     def params_from_par(self, parfile):
-        """Load pulsar parameters from a .par file (stubbed upstream,
-        simulate.py:195-199)."""
-        raise NotImplementedError()
+        """Load pulsar parameters from a TEMPO/PINT-style .par file.
+
+        Stubbed in the reference (simulate.py:195-199); completed here
+        (DIVERGENCES.md #15): PSR -> name, F0/F/P0 -> period, DM -> dm.
+        Only spin/name/DM enter the simulation; other timing-model terms
+        are left for the polyco stage, which validates them at save time
+        (io/polyco.py).
+        """
+        from ..io import parse_par
+
+        pars = parse_par(parfile)
+        if "PSR" in pars:
+            self._name = str(pars["PSR"])
+        elif "PSRJ" in pars:
+            self._name = str(pars["PSRJ"])
+        if "F0" in pars:
+            self._period = 1.0 / float(pars["F0"])
+        elif "F" in pars:
+            self._period = 1.0 / float(pars["F"])
+        elif "P0" in pars:
+            self._period = float(pars["P0"])
+        if "DM" in pars:
+            self._dm = float(pars["DM"])
 
     # -- builders ----------------------------------------------------------
     def init_signal(self, from_template=False):

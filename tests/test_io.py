@@ -296,11 +296,11 @@ class TestPSRFITS:
             assert banned not in params
 
     def test_stubs(self):
+        # append remains a stub (reference parity); load() is implemented
+        # (tests/test_load_roundtrip.py)
         pfit = PSRFITS(path="/tmp/x.fits", template=TEMPLATE, obs_mode="PSR")
         with pytest.raises(NotImplementedError):
             pfit.append(None)
-        with pytest.raises(NotImplementedError):
-            pfit.load()
 
 
 class TestTxtFile:

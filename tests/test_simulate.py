@@ -58,8 +58,10 @@ class TestConfig:
         s = Simulation(fcent=999.0, psrdict=SIMDICT)
         assert s.fcent == 1400.0
 
-    def test_parfile_not_implemented(self):
-        with pytest.raises(NotImplementedError):
+    def test_parfile_missing_raises(self):
+        # params_from_par is implemented (DIVERGENCES #15,
+        # tests/test_load_roundtrip.py); a missing file fails loudly
+        with pytest.raises(FileNotFoundError):
             Simulation(parfile="fake.par")
 
 
