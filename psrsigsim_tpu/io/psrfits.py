@@ -404,11 +404,14 @@ class PSRFITS(BaseFile):
         S._nsamp = data.shape[1]
         S._nsub = rows
         S._fold = loader.obs_mode != "SEARCH"
-        # the SUBINT header carries the dispersion the data were written
-        # with; PSRPARAM (which make_signal_from_psrfits consulted) is the
-        # template's copied timing block and may disagree
+        # the SUBINT header carries the dispersion and cadence the data
+        # were written with; PSRPARAM (which make_signal_from_psrfits
+        # consulted for F0) is the template's copied timing block and may
+        # disagree — TBIN is authoritative for the sample rate
         if hdr.get("DM") is not None:
             S._dm = make_quant(float(hdr["DM"]), "pc/cm^3")
+        if hdr.get("TBIN"):
+            S._samprate = make_quant(1e-6 / float(hdr["TBIN"]), "MHz")
         return S
 
     # -- template -> signal -------------------------------------------------

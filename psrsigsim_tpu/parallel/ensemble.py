@@ -440,14 +440,19 @@ class MultiPulsarFoldEnsemble:
         self._bucket_data[bkey] = staged
         return staged
 
-    def run(self, epochs, seed=0):
+    def run(self, epochs, seed=0, epoch_start=0):
         """Simulate ``epochs`` observations of every pulsar.
 
         Returns a list (indexed like ``workloads``) of device arrays
         ``(epochs, Nchan, nsub*Nph)`` — shapes differ across buckets, which
-        is the point of bucketing.  For very large ``epochs``, call
-        repeatedly with shifted seeds and concatenate on host to bound the
-        per-program working set.
+        is the point of bucketing.
+
+        For very large runs (the 128-pulsar × 64-chan workload OOMs beyond
+        a few epochs per program on a 16 GB chip), chunk the epoch axis:
+        ``run(E1, seed)`` followed by ``run(E2, seed, epoch_start=E1)``
+        draws exactly what one ``run(E1+E2, seed)`` would — keys derive
+        from ``(seed, global pulsar index, global epoch index)``, so the
+        streams are invariant to chunking, bucketing, and mesh shape.
         """
         root = jax.random.key(seed)
         results = [None] * len(self.workloads)
@@ -456,15 +461,17 @@ class MultiPulsarFoldEnsemble:
             cfg0 = self.workloads[members[0]][0]
             st = self._staged(bkey, members)
 
-            # key[p, e] from the GLOBAL pulsar index: bucket- and
-            # mesh-invariant (padding rows replicate the true pulsar's keys)
+            # key[p, e] = fold_in(stage_key(root, "user", p), global e):
+            # padding rows replicate the true pulsar's keys
             keys = jax.vmap(
                 jax.vmap(
-                    lambda p, e: stage_key(root, "user", p * epochs + e),
+                    lambda p, e: jax.random.fold_in(
+                        stage_key(root, "user", p), e
+                    ),
                     in_axes=(None, 0),
                 ),
                 in_axes=(0, None),
-            )(st["padded"], jnp.arange(epochs))
+            )(st["padded"], epoch_start + jnp.arange(epochs))
             keys = jax.device_put(keys, st["obs_sharding"])
 
             prog = self._program(bkey, cfg0, epochs)

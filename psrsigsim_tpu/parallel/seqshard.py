@@ -311,8 +311,8 @@ def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
 
 
 def _seq_prologue(cfg, mesh):
-    """Shared setup for the baseband seq-sharded builders: default mesh,
-    divisibility + int32 guards, slab length."""
+    """Shared setup for the seq-sharded builders (search and baseband):
+    default mesh, divisibility + int32 guards, slab length."""
     if mesh is None:
         mesh = make_seq_mesh()
     n = mesh.shape[SEQ_AXIS]

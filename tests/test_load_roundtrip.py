@@ -48,6 +48,8 @@ class TestPSRFITSLoad:
         assert data.shape == orig.shape
         assert np.abs(data - orig).max() <= 0.51 * scl.max()
         assert float(back.dm.value) == pytest.approx(11.0)
+        # cadence restored from SUBINT TBIN, not the template's PSRPARAM F0
+        assert float(back.samprate.to("MHz").value) == pytest.approx(0.2048)
 
     def test_search_roundtrip(self, tmp_path, monkeypatch):
         monkeypatch.chdir(tmp_path)
