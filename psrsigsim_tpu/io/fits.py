@@ -43,14 +43,12 @@ _TFORM_DTYPES = {
 class Card:
     """One 80-character header card; keeps the raw image for fidelity."""
 
-    __slots__ = ("image",)
+    __slots__ = ("image", "key")
 
     def __init__(self, image):
         self.image = image.ljust(CARDLEN)[:CARDLEN]
-
-    @property
-    def key(self):
-        return self.image[:8].strip()
+        # cached: headers are scanned by key thousands of times per file
+        self.key = self.image[:8].strip()
 
     # -- value parsing -----------------------------------------------------
     @property
@@ -167,10 +165,16 @@ def _fmt_str(value):
 
 
 class Header:
-    """Ordered collection of cards with dict-style access by key."""
+    """Ordered collection of cards with dict-style access by key.
+
+    ``cards`` must be mutated through the Header methods (``__setitem__``
+    appends/replaces) — a lazy key index accelerates the lookups that
+    dominate bulk PSRFITS writing.
+    """
 
     def __init__(self, cards=None):
         self.cards = list(cards) if cards else []
+        self._idx = None  # lazy {key: first index}
 
     @classmethod
     def parse(cls, raw):
@@ -183,11 +187,12 @@ class Header:
         raise ValueError("header block missing END card")
 
     def _find(self, key):
-        key = key.upper()
-        for i, c in enumerate(self.cards):
-            if c.key == key:
-                return i
-        return -1
+        if self._idx is None:
+            idx = {}
+            for i, c in enumerate(self.cards):
+                idx.setdefault(c.key, i)
+            self._idx = idx
+        return self._idx.get(key.upper(), -1)
 
     def __contains__(self, key):
         return self._find(key) >= 0
@@ -205,10 +210,12 @@ class Header:
     def __setitem__(self, key, value):
         i = self._find(key)
         if i >= 0:
-            self.cards[i] = self.cards[i].with_value(value)
+            self.cards[i] = self.cards[i].with_value(value)  # key unchanged
         else:
             # insert before END position (i.e. append)
             self.cards.append(Card.make(key, value))
+            if self._idx is not None:
+                self._idx.setdefault(self.cards[-1].key, len(self.cards) - 1)
 
     def keys(self):
         return [c.key for c in self.cards if c.key]
