@@ -91,7 +91,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                 pfit.get_signal_params(signal=sig)
                 pfit.save(sig, pulsar, parfile=parfile, MJD_start=MJD_start,
                           ref_MJD=ref_MJD,
-                          quantized=(data[j], scl[j], offs[j]))
+                          quantized=(data[j], scl[j], offs[j]),
+                          verbose=False)
                 os.replace(tmp, paths[i])
     finally:
         sig._dm = dm0

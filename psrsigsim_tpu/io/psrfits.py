@@ -219,7 +219,8 @@ class PSRFITS(BaseFile):
     # -- the save path ------------------------------------------------------
     def save(self, signal, pulsar, parfile=None, MJD_start=56000.0,
              segLength=60.0, inc_len=0.0, ref_MJD=56000.0, usePint=True,
-             eq_wts=True, quantized=None, strict_polyco=True):
+             eq_wts=True, quantized=None, strict_polyco=True,
+             verbose=True):
         """Save the signal to disk as PSRFITS (reference:
         io/psrfits.py:305-424).  See that docstring for parameter meanings.
 
@@ -312,8 +313,9 @@ class PSRFITS(BaseFile):
                 )
 
         if parfile is None:
-            print("No parfile provided, creating par file %s_sim.par"
-                  % (pulsar.name))
+            if verbose:
+                print("No parfile provided, creating par file %s_sim.par"
+                      % (pulsar.name))
             make_par(signal, pulsar, outpar="%s_sim.par" % (pulsar.name))
             parfile = "%s_sim.par" % (pulsar.name)
 
@@ -334,7 +336,10 @@ class PSRFITS(BaseFile):
         self._edit_psrfits_header(polyco_dict, subint_dict, primary_dict)
 
         self.write_psrfits(hdr_from_draft=True)
-        print("Finished writing and saving the file")
+        if verbose:
+            # reference parity chatter (io/psrfits.py:424); bulk exporters
+            # pass verbose=False and report via their progress callback
+            print("Finished writing and saving the file")
 
     def write_psrfits(self, hdr_from_draft=True):
         """Assemble draft headers + tables into a FITS file on disk."""
