@@ -8,10 +8,12 @@ from .seqshard import (
     blocked_chan_chi2,
     blocked_chan_normal,
     dispersion_halo_samples,
+    make_obs_seq_mesh,
     make_seq_mesh,
     seq_sharded_baseband,
     seq_sharded_dedisperse,
     seq_sharded_search,
+    seq_sharded_search_ensemble,
 )
 from .mesh import (
     CHAN_AXIS,
@@ -39,6 +41,8 @@ __all__ = [
     "seq_sharded_search",
     "seq_sharded_baseband",
     "seq_sharded_dedisperse",
+    "seq_sharded_search_ensemble",
+    "make_obs_seq_mesh",
     "dispersion_halo_samples",
     "blocked_chan_chi2",
     "blocked_chan_normal",

@@ -47,6 +47,7 @@ except AttributeError:  # pragma: no cover
 __all__ = ["SEQ_AXIS", "SEQ_RNG_BLOCK", "make_seq_mesh",
            "seq_sharded_search", "seq_sharded_baseband",
            "seq_sharded_dedisperse", "dispersion_halo_samples",
+           "make_obs_seq_mesh", "seq_sharded_search_ensemble",
            "blocked_chan_chi2", "blocked_chan_normal"]
 
 SEQ_AXIS = "seq"
@@ -121,31 +122,15 @@ def blocked_chan_normal(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK):
 
 
 
-def seq_sharded_search(cfg, mesh=None):
-    """Compile the SEARCH-mode pipeline with the time axis sharded over
-    ``mesh``'s ``'seq'`` axis.
-
-    Semantics mirror :func:`~psrsigsim_tpu.simulate.single_pipeline`
-    (synthesis → in-graph nulling → dispersion shift → radiometer noise;
-    reference chain pulsar.py:222-333, ism.py:40-74, receiver.py:140-172)
-    with one difference: random draws are block-keyed (see
-    :func:`blocked_chan_chi2`) instead of one stream per channel, so the
-    two pipelines agree in distribution but not sample-for-sample.  Within
-    this pipeline, results are bit-identical for ANY sequence shard count
-    (tests/test_seqshard.py).
-
-    Requires ``cfg.nsamp`` and ``cfg.meta.nchan`` divisible by the shard
-    count.  Returns ``run(key, dm, noise_norm, profiles) -> (Nchan, nsamp)``
-    jitted and sharded ``P(None, 'seq')``.
-    """
-    mesh, n, L = _seq_prologue(cfg, mesh)
+def _search_seq_body(cfg, n, L):
+    """The per-shard SEARCH body over a ``(Nchan, L)`` time slab: blocked
+    synthesis + nulling, all_to_all transposes around the exact Fourier
+    shift, blocked noise.  Shared by the 1-D seq pipeline and the 2-D
+    (obs × seq) ensemble; vmapping it batches the collectives."""
     nchan = cfg.meta.nchan
-    nsamp = cfg.nsamp
-    if nchan % n:
-        raise ValueError(f"Nchan={nchan} must be divisible by the seq axis ({n})")
     freqs_full = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float32)
 
-    def _local(key, dm, noise_norm, profiles, extra_delays_ms):
+    def body(key, dm, noise_norm, profiles, extra_delays_ms):
         # profiles (Nchan, nph) replicated; this shard owns global time
         # span [t0, t0 + L)
         shard = lax.axis_index(SEQ_AXIS)
@@ -187,8 +172,33 @@ def seq_sharded_search(cfg, mesh=None):
         noise = blocked_chan_chi2(kn, chan_ids, cfg.noise_df, t0, L)
         return block + noise * noise_norm
 
+    return body
+
+
+def seq_sharded_search(cfg, mesh=None):
+    """Compile the SEARCH-mode pipeline with the time axis sharded over
+    ``mesh``'s ``'seq'`` axis.
+
+    Semantics mirror :func:`~psrsigsim_tpu.simulate.single_pipeline`
+    (synthesis → in-graph nulling → dispersion shift → radiometer noise;
+    reference chain pulsar.py:222-333, ism.py:40-74, receiver.py:140-172)
+    with one difference: random draws are block-keyed (see
+    :func:`blocked_chan_chi2`) instead of one stream per channel, so the
+    two pipelines agree in distribution but not sample-for-sample.  Within
+    this pipeline, results are bit-identical for ANY sequence shard count
+    (tests/test_seqshard.py).
+
+    Requires ``cfg.nsamp`` and ``cfg.meta.nchan`` divisible by the shard
+    count.  Returns ``run(key, dm, noise_norm, profiles) -> (Nchan, nsamp)``
+    jitted and sharded ``P(None, 'seq')``.
+    """
+    mesh, n, L = _seq_prologue(cfg, mesh)
+    nchan = cfg.meta.nchan
+    if nchan % n:
+        raise ValueError(f"Nchan={nchan} must be divisible by the seq axis ({n})")
+
     sharded = shard_map(
-        _local,
+        _search_seq_body(cfg, n, L),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(None, None), P(None)),
         out_specs=P(None, SEQ_AXIS),
@@ -379,3 +389,85 @@ def _make_dedisp_local(cfg, dm, n, L, halo):
         return y[:, hl : hl + L]
 
     return dedisp
+
+
+# ---------------------------------------------------------------------------
+# DP x SP composition: ensembles of time-sharded observations
+# ---------------------------------------------------------------------------
+
+
+def make_obs_seq_mesh(shape, devices=None):
+    """2-D ``('obs', 'seq')`` mesh: observations data-parallel along the
+    first axis, each observation's time axis sharded along the second.
+
+    An explicitly passed device list must tile ``shape`` exactly
+    (``make_mesh``'s strictness); the default device list is truncated to
+    the needed count, erroring if too few are visible.
+    """
+    n = shape[0] * shape[1]
+    if devices is None:
+        devices = jax.devices()
+        if len(devices) < n:
+            raise ValueError(
+                f"mesh shape {shape} needs {n} devices; {len(devices)} visible"
+            )
+        devices = devices[:n]
+    elif n != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} does not tile {len(devices)} explicit devices"
+        )
+    from .mesh import OBS_AXIS
+
+    return Mesh(np.asarray(devices).reshape(shape), (OBS_AXIS, SEQ_AXIS))
+
+
+def seq_sharded_search_ensemble(cfg, mesh):
+    """SEARCH-mode Monte-Carlo ensemble over a 2-D ``(obs, seq)`` mesh —
+    the DP × SP composition: a batch of observations shards data-parallel
+    over the ``obs`` axis while EACH observation's time axis shards over
+    ``seq`` (the :func:`seq_sharded_search` body, vmapped — the
+    all_to_all transposes batch over the local observations).
+
+    Draws are keyed by (per-observation key, channel, global RNG block),
+    so results are bit-identical for any mesh shape with the same padded
+    program width.
+
+    Returns ``run(keys, dms, noise_norms, profiles, extra_delays_ms=None)
+    -> (B, Nchan, nsamp)``.  ``B`` must divide by the obs-axis size.
+    """
+    from .mesh import OBS_AXIS
+
+    _, n_seq, L = _seq_prologue(cfg, mesh)
+    nchan = cfg.meta.nchan
+    if nchan % n_seq:
+        raise ValueError(
+            f"Nchan={nchan} must be divisible by the seq axis ({n_seq})"
+        )
+    body = _search_seq_body(cfg, n_seq, L)
+    n_obs_shards = mesh.shape[OBS_AXIS]
+
+    def _local(keys, dms, norms, profiles, extra_delays_ms):
+        return jax.vmap(
+            lambda k, d, nn: body(k, d, nn, profiles, extra_delays_ms)
+        )(keys, dms, norms)
+
+    sharded = shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(P(OBS_AXIS), P(OBS_AXIS), P(OBS_AXIS), P(None, None),
+                  P(None)),
+        out_specs=P(OBS_AXIS, None, SEQ_AXIS),
+    )
+
+    @jax.jit
+    def run(keys, dms, noise_norms, profiles, extra_delays_ms=None):
+        if keys.shape[0] % n_obs_shards:
+            raise ValueError(
+                f"batch {keys.shape[0]} must be divisible by the obs axis "
+                f"({n_obs_shards})"
+            )
+        if extra_delays_ms is None:
+            extra_delays_ms = jnp.zeros(nchan, jnp.float32)
+        return sharded(keys, dms, noise_norms, profiles, extra_delays_ms)
+
+    return run
