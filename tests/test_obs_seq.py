@@ -16,6 +16,14 @@ from psrsigsim_tpu.parallel import (
 from psrsigsim_tpu.simulate import Simulation, build_single_config
 
 
+# the sharding-matrix cases need the 8-way virtual CPU mesh
+# (tests/conftest.py); on real hardware with fewer chips they skip —
+# device-count-independent tests below stay unmarked
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 devices (virtual CPU mesh lane)"
+)
+
+
 def _cfg(nchan=8, tobs=0.2):
     d = {
         "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
@@ -42,6 +50,7 @@ def _inputs(n, nn, seed=0):
 
 
 class TestObsSeqEnsemble:
+    @needs8
     def test_shapes_and_batch(self):
         cfg, profiles, nn = _cfg()
         run = seq_sharded_search_ensemble(cfg, make_obs_seq_mesh((4, 2)))
@@ -49,6 +58,7 @@ class TestObsSeqEnsemble:
         out = np.asarray(run(keys, dms, norms, profiles))
         assert out.shape == (8, cfg.meta.nchan, cfg.nsamp)
 
+    @needs8
     def test_mesh_shape_invariance(self):
         # same batch over (4,2), (2,4), (8,1) meshes: per-observation seq
         # bodies use block-keyed draws, so outputs agree to the FFT
@@ -67,6 +77,7 @@ class TestObsSeqEnsemble:
         assert np.allclose(base, outs[(8, 1)], rtol=2e-6,
                            atol=5e-3 * base.std())
 
+    @needs8
     def test_matches_1d_seq_pipeline_per_obs(self):
         # each batch entry equals running the 1-D seq pipeline with that
         # observation's key (same seq width -> bit-identical draws)
@@ -80,6 +91,7 @@ class TestObsSeqEnsemble:
             assert np.allclose(out2d[i], ref, rtol=2e-6,
                                atol=1e-3 * ref.std()), i
 
+    @needs8
     def test_batch_divisibility_enforced(self):
         cfg, profiles, nn = _cfg()
         run = seq_sharded_search_ensemble(cfg, make_obs_seq_mesh((4, 2)))

@@ -19,6 +19,14 @@ from psrsigsim_tpu.signal import BasebandSignal
 from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
 
 
+# the sharding-matrix cases need the 8-way virtual CPU mesh
+# (tests/conftest.py); on real hardware with fewer chips they skip —
+# device-count-independent tests below stay unmarked
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 devices (virtual CPU mesh lane)"
+)
+
+
 def _bb_cfg(dm=2.0, bw=4.0, fcent=1400.0, tobs=0.016384):
     """A narrow-band baseband config whose smearing is a small halo."""
     sig = BasebandSignal(fcent, bw, sample_rate=2 * bw)
@@ -37,12 +45,14 @@ class TestHaloSize:
         sweep_s = (1.0 / 2.41e-4) * 2.0 * (1398.0**-2 - 1402.0**-2)
         assert halo == int(np.ceil(4.0 * sweep_s * 1e6 / 0.125)) + 1
 
+    @needs8
     def test_halo_must_fit_slab(self):
         cfg, _, _ = _bb_cfg()
         with pytest.raises(ValueError, match="smearing"):
             seq_sharded_dedisperse(cfg, dm=2.0, mesh=make_seq_mesh(8),
                                    halo=cfg.nsamp)
 
+    @needs8
     def test_zero_halo_rejected(self):
         cfg, _, _ = _bb_cfg()
         with pytest.raises(ValueError, match="halo"):
@@ -64,6 +74,7 @@ class TestHaloSize:
             dispersion_halo_samples(2.0, 1400.0, 4.0, 0.125)
 
 
+@needs8
 class TestShardedDedisperse:
     def test_matches_circular_reference(self):
         cfg, _, _ = _bb_cfg()
@@ -102,6 +113,7 @@ class TestShardedDedisperse:
         assert errs[1] <= errs[0]
 
 
+@needs8
 class TestShardedBasebandPipeline:
     def test_shard_count_consistency(self):
         cfg, sqrt_profiles, nn = _bb_cfg()
