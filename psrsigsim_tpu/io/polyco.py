@@ -1,177 +1,144 @@
 """Polyco generation for PSRFITS phase connection — PINT replacement.
 
-The reference delegates to ``pint.polycos`` with a TEMPO-style fit
-(reference: io/psrfits.py:116-181).  PINT is unavailable here, and for the
-signals this framework simulates the timing model is an isolated spin model
-(the generated par files carry F0/DM and fixed defaults with TZRSITE='@',
-utils/utils.py:350-395), so the polyco is computed in closed form instead of
-fit: for phase
+The reference delegates to ``pint.polycos`` with a TEMPO-style fit over
+the full timing model — binary orbit, astrometry, dispersion variation
+included (reference: io/psrfits.py:116-181).  Here the same thing is done
+natively: :class:`psrsigsim_tpu.io.timing.TimingModel` evaluates absolute
+phase (spin + solar-system barycentering + binary delays + DM/DMX/FD) on
+a Chebyshev node grid across the span, and the TEMPO polyco coefficient
+convention
 
-    phi(t) = F0 * dt_s + F1/2 * dt_s^2,   dt_s = (t - PEPOCH) * 86400
+    phi(t) = REF_PHS + 60*REF_F0*dt_min + COEFF[0] + COEFF[1]*dt_min + ...
 
-the TEMPO polyco convention
+is least-squares fitted to it.  The fit reproduces the model's own phase
+to < 1e-6 cycles over the span (asserted by tests/test_timing.py); the
+model's absolute accuracy against a JPL-ephemeris fit is set by the
+analytic ephemeris (see :mod:`psrsigsim_tpu.io.ephem`).
 
-    phi(t) = RPHASE + COEFF1 + 60*F0_ref*dt_min + COEFF2*dt_min + ...
-
-is satisfied exactly by Taylor expansion about the segment midpoint — no
-node fitting, no fit residuals.  For barycentric/observatory-corrected
-models, feed polycos from an external tool instead.
+Models with terms that cannot be honored (glitches, TCB units, unknown
+binary models or site codes) raise :class:`UnsupportedTimingModelError`
+under ``strict=True`` rather than mispredicting silently.
 """
 
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
+
+from .timing import (TimingModel, UnsupportedTimingModelError,
+                     check_model_supported, parse_par_full)
 
 __all__ = ["parse_par", "generate_polyco", "polyco_phase",
            "UnsupportedTimingModelError", "check_par_supported"]
 
 
-class UnsupportedTimingModelError(ValueError):
-    """The par file carries timing-model terms the closed-form spin polyco
-    cannot honor (binary orbit, proper motion/parallax, F2+, glitches,
-    topocentric reference site).  The reference handles these through a
-    PINT/TEMPO fit (reference: io/psrfits.py:144-177); here they must be
-    rejected rather than silently ignored."""
-
-
-# binary-orbit terms (any binary model)
-_BINARY_TERMS = frozenset({
-    "BINARY", "PB", "A1", "T0", "OM", "ECC", "E", "SINI", "M2", "TASC",
-    "EPS1", "EPS2", "PBDOT", "OMDOT", "XDOT", "EDOT", "GAMMA", "MTOT",
-    "KOM", "KIN", "SHAPMAX", "H3", "H4", "STIG",
-})
-# astrometric motion terms (position alone is fine at a barycentric site)
-_ASTROMETRY_TERMS = frozenset({
-    "PMRA", "PMDEC", "PMLAMBDA", "PMBETA", "PMELONG", "PMELAT", "PX",
-})
-# time-variable dispersion (shifts absolute phase at REF_FREQ over time)
-_DM_VAR_PREFIXES = ("DMX", "DM1", "DM2", "DM3")
-# glitches and orbital-frequency series
-_EVENT_PREFIXES = ("GLEP_", "GLPH_", "GLF0", "GLF1", "GLF2", "FB")
-
-
 def check_par_supported(params, parfile="<par>"):
-    """Raise :class:`UnsupportedTimingModelError` if ``params`` (a
-    :func:`parse_par` dict) holds terms the closed-form polyco ignores.
-
-    The closed form honors exactly: F0, F1, PEPOCH, TZRFRQ, TZRMJD and a
-    barycentric TZRSITE ('@'); sky position, DM, and fit metadata are
-    allowed because they do not enter the barycentric spin phase.
-    """
-    bad = []
-    for key, val in params.items():
-        offending = (
-            key in _BINARY_TERMS
-            or key in _ASTROMETRY_TERMS
-            or key.startswith(_EVENT_PREFIXES)
-            or key.startswith(_DM_VAR_PREFIXES)
-            or (key.startswith("F") and key[1:].isdigit()
-                and int(key[1:]) >= 2)
-        )
-        # zero-valued numeric terms have no effect on the phase model
-        # (make_par writes PMLAMBDA/PMBETA/PX 0.0 defaults, mirroring the
-        # reference's utils/utils.py:369-371)
-        if offending and not (isinstance(val, float) and val == 0.0):
-            bad.append(key)
-    site = str(params.get("TZRSITE", "@")).strip()
-    if site not in ("@", "0", "bat", "BAT"):
-        bad.append(f"TZRSITE={site}")
-    if bad:
-        raise UnsupportedTimingModelError(
-            f"par file {parfile} contains timing-model terms the "
-            f"closed-form polyco cannot honor: {sorted(set(bad))}. "
-            "Generate polycos with PINT/TEMPO externally, or pass "
-            "strict=False to knowingly ignore them."
-        )
+    """Raise :class:`UnsupportedTimingModelError` if ``params`` holds
+    terms the numeric polyco fit cannot honor.  Round 2 rejected every
+    binary/astrometric/DM-variation term; the numeric timing model now
+    covers those, so only glitches, FB series, TCB units, unknown binary
+    models, and unknown site codes remain unsupported."""
+    check_model_supported(params, parfile=parfile)
 
 
 def parse_par(parfile):
     """Parse a TEMPO/PINT-style .par file into a dict of strings/floats.
 
-    Handles the subset the framework writes and reads: flag-style values stay
-    strings; numeric values become float (with Fortran 'D' exponents).
+    Alias for :func:`psrsigsim_tpu.io.timing.parse_par_full`: flag-style
+    values stay strings, numeric values become floats (longdouble for
+    epoch keys), repeated flagged lines (JUMP/T2EFAC/...) are collected
+    under ``key + "#"``.
     """
-    params = {}
-    with open(parfile) as f:
-        for line in f:
-            parts = line.split()
-            if not parts or parts[0].startswith("#"):
-                continue
-            key = parts[0]
-            if len(parts) == 1:
-                params[key] = ""
-                continue
-            val = parts[1]
-            try:
-                params[key] = float(val.replace("D", "E").replace("d", "e"))
-            except ValueError:
-                params[key] = val
-    return params
+    return parse_par_full(parfile)
 
 
 def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
-                    strict=True):
-    """Closed-form polyco for an isolated spin model (F0 [, F1]).
+                    strict=True, obs_freq=None, site=None):
+    """Numeric TEMPO-style polyco fit over the full timing model.
+
+    Evaluates :class:`~psrsigsim_tpu.io.timing.TimingModel` absolute phase
+    (spin + barycentric Roemer/parallax/Shapiro + binary + DM/DMX/FD) on
+    Chebyshev nodes across the span and least-squares fits the TEMPO
+    coefficient form — the same construction the reference obtains from
+    ``pint.polycos`` (reference: io/psrfits.py:116-181).
 
     Args:
-        parfile: path to the .par file (needs F0; optional F1, PEPOCH,
-            TZRFRQ, TZRSITE, TZRMJD).
-        MJD_start: start MJD of the span.
+        parfile: path to the .par file.
+        MJD_start: start MJD (UTC for topocentric sites; TDB for '@').
         segLength: span length in minutes (NSPAN).
-        ncoeff: number of coefficients (NCOEF); extras are zero.
+        ncoeff: number of coefficients (NCOEF).
         strict: when True (default), raise
-            :class:`UnsupportedTimingModelError` if the par file carries
-            binary/astrometric-motion/F2+/glitch/DM-variation terms or a
-            topocentric TZRSITE — the closed form would silently mispredict
-            phase for those models.  ``strict=False`` ignores them.
+            :class:`UnsupportedTimingModelError` for model terms that
+            cannot be honored (glitches, FB series, TCB units, unknown
+            binary models/site codes).  ``strict=False`` ignores them.
+        obs_freq: observing frequency in MHz for the dispersion terms
+            (default: the par file's TZRFRQ).
+        site: TEMPO observatory code the polyco is computed for
+            (default: the par file's TZRSITE).
 
     Returns:
         dict with the keys the PSRFITS POLYCO table wants: NSPAN, NCOEF,
         REF_FREQ, NSITE, REF_F0, COEFF, REF_MJD, REF_PHS — mirroring the
         reference's polyco_dict (io/psrfits.py:144-177).
     """
-    m = parse_par(parfile)
-    if strict:
-        check_par_supported(m, parfile=parfile)
-    if "F0" in m:
-        f0 = float(m["F0"])
-    elif "F" in m:
-        f0 = float(m["F"])
-    else:
-        raise ValueError(f"par file {parfile} has no F0")
-    f1 = float(m.get("F1", 0.0))
-    pepoch = float(m.get("PEPOCH", 56000.0))
-    ref_freq = float(m.get("TZRFRQ", 1500.0))
-    nsite = str(m.get("TZRSITE", "@"))
+    model = TimingModel.from_par(parfile, strict=strict)
+    f0 = float(model.f_terms[0])
+    if site is None:
+        site = model.tzrsite
+    if obs_freq is None:
+        obs_freq = model.tzrfrq
+    # no frequency anywhere -> phases are infinite-frequency (no
+    # dispersion); REF_FREQ=0 marks that honestly instead of claiming a
+    # band the fit was never computed for
+    ref_freq = float(obs_freq) if obs_freq else 0.0
 
-    seg_days = segLength / 1440.0
-    tmid = MJD_start + seg_days / 2.0
+    half_min = segLength / 2.0
+    # anchor the fit at the float64-representable midpoint: REF_MJD is
+    # stored as a double in the POLYCO table, and a sub-ulp mismatch
+    # between the fit anchor and the stored value leaks F0 * 3e-7 s
+    # (~5e-5 cycles) of constant phase error into every prediction
+    tmid = np.longdouble(np.float64(MJD_start + segLength / 2880.0))
 
-    # absolute phase at tmid for phi(t) = F0*dt + F1/2*dt^2 (dt in s from
-    # PEPOCH)
-    dt_s = (tmid - pepoch) * 86400.0
-    phase_mid = f0 * dt_s + 0.5 * f1 * dt_s**2
-    freq_mid = f0 + f1 * dt_s  # apparent spin frequency at tmid
+    # Chebyshev-distributed nodes over the span (8x oversampled LSQ)
+    nnodes = max(8 * ncoeff, 48)
+    xnodes = np.cos(np.pi * np.arange(nnodes) / (nnodes - 1))  # [-1, 1]
+    t_nodes = tmid + np.asarray(xnodes * (half_min / 1440.0),
+                                np.float64).astype(np.longdouble)
+    phases = model.phase(t_nodes, freq_mhz=obs_freq, site=site)
+    phase_mid = model.phase(np.atleast_1d(tmid), freq_mhz=obs_freq,
+                            site=site)[0]
 
-    # TEMPO convention: phi(t) = RPHASE + COEFF[0] + 60*REF_F0*dt_min
-    #                           + COEFF[1]*dt_min + COEFF[2]*dt_min^2 + ...
-    # with REF_F0 reported as F0.  Taylor about tmid:
-    #   phi = phase_mid + freq_mid*60*dt_min + (F1/2)*3600*dt_min^2
-    # so COEFF[1] absorbs the (freq_mid - F0) drift term.
-    coeffs = np.zeros(ncoeff, dtype=np.float64)
-    coeffs[0] = 0.0
-    if ncoeff > 1:
-        coeffs[1] = (freq_mid - f0) * 60.0
-    if ncoeff > 2:
-        coeffs[2] = 0.5 * f1 * 3600.0
+    # subtract the TEMPO linear term and the midpoint phase in longdouble;
+    # the residual is small enough for a float64 Chebyshev fit
+    dt_min = np.asarray((t_nodes - tmid) * 1440.0, np.float64)
+    lin = (np.longdouble(60.0 * f0) *
+           (t_nodes - tmid) * np.longdouble(1440.0))
+    resid = np.asarray(phases - phase_mid - lin, np.float64)
 
-    ref_phs = phase_mid - np.floor(phase_mid)  # fractional, always positive
+    deg = min(ncoeff - 1, nnodes - 1)
+    cheb = np.polynomial.chebyshev.Chebyshev.fit(
+        dt_min / half_min, resid, deg, domain=[-1.0, 1.0])
+    poly = cheb.convert(kind=np.polynomial.Polynomial)
+    coeffs = np.zeros(ncoeff, np.float64)
+    scale = np.power(half_min, -np.arange(deg + 1, dtype=np.float64))
+    coeffs[:deg + 1] = poly.coef * scale
+
+    fit = np.polynomial.polynomial.polyval(dt_min, coeffs)
+    fit_err = float(np.max(np.abs(fit - resid)))
+    if fit_err > 1e-6:
+        warnings.warn(
+            f"polyco fit residual {fit_err:.2e} cycles exceeds 1e-6 over "
+            f"a {segLength:.0f}-minute span; use a shorter segLength or "
+            f"more coefficients", RuntimeWarning)
+
+    ref_phs = np.float64(phase_mid - np.floor(phase_mid))
 
     return {
         "NSPAN": segLength,
         "NCOEF": ncoeff,
         "REF_FREQ": ref_freq,
-        "NSITE": nsite.encode("utf-8"),
+        "NSITE": str(site).encode("utf-8"),
         "REF_F0": f0,
         "COEFF": coeffs,
         "REF_MJD": np.double(tmid),

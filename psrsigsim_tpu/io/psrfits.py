@@ -107,20 +107,24 @@ class PSRFITS(BaseFile):
     # -- polyco + metadata --------------------------------------------------
     def _gen_polyco(self, parfile, MJD_start, segLength=60.0, ncoeff=15,
                     maxha=12.0, method="TEMPO", numNodes=20, usePINT=True,
-                    strict=True):
+                    strict=True, obs_freq=None):
         """Polyco parameters for the POLYCO HDU.
 
         Signature mirrors the reference (io/psrfits.py:116-143); generation
-        is closed-form for the isolated spin model (see io/polyco.py) rather
-        than a PINT TEMPO fit.  ``usePINT=False`` raises, as upstream.
-        ``strict=False`` skips the unsupported-timing-model gate.
+        is a numeric least-squares fit over the native timing model
+        (spin + barycentric Roemer/parallax/Shapiro + binary + DM/DMX/FD;
+        see io/timing.py), replacing the reference's PINT TEMPO fit.
+        ``usePINT=False`` raises, as upstream.  ``strict=False`` skips the
+        unsupported-timing-model gate.  ``obs_freq`` (MHz) computes the
+        polyco at the observing frequency instead of the par's TZRFRQ.
         """
         if not usePINT:
             raise NotImplementedError(
                 "Only the PINT-equivalent path is supported for polycos"
             )
         return generate_polyco(parfile, MJD_start, segLength=segLength,
-                               ncoeff=ncoeff, strict=strict)
+                               ncoeff=ncoeff, strict=strict,
+                               obs_freq=obs_freq)
 
     def _gen_metadata(self, signal, pulsar, ref_MJD=56000.0, inc_len=0.0):
         """PRIMARY/SUBINT phase-connection numbers: OFFS_SUB per subint and
@@ -321,7 +325,8 @@ class PSRFITS(BaseFile):
 
         polyco_dict = self._gen_polyco(parfile, MJD_start,
                                        segLength=segLength, ncoeff=15,
-                                       usePINT=usePint, strict=strict_polyco)
+                                       usePINT=usePint, strict=strict_polyco,
+                                       obs_freq=float(signal.fcent.value))
         primary_dict, subint_dict = self._gen_metadata(
             signal, pulsar, ref_MJD=ref_MJD, inc_len=inc_len
         )

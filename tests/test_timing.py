@@ -1,0 +1,235 @@
+"""Tests for the native timing model (io/ephem.py, io/timing.py) and the
+numeric polyco fit (io/polyco.py) — the framework's PINT replacement
+(reference: io/psrfits.py:116-181, utils/utils.py:342-348).
+
+The headline acceptance criterion (VERDICT round-2 'do this' #1): the
+vendored NANOGrav par files — DDK/DD binaries, ecliptic astrometry with
+proper motion and parallax, DMX, FD terms, topocentric sites — are
+accepted under strict=True, and the fitted polyco reproduces the timing
+model's own phase to < 1e-6 cycles across the span.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.data import data_path
+from psrsigsim_tpu.io import ephem
+from psrsigsim_tpu.io.polyco import generate_polyco
+from psrsigsim_tpu.io.timing import (
+    TimingModel,
+    UnsupportedTimingModelError,
+    parse_par_full,
+)
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "..", "data")
+J1713_PAR = data_path("J1713+0747_NANOGrav_11yv1.gls.par")
+J1910_PAR = os.path.join(DATA_DIR, "J1910+1256_NANOGrav_11yv1.gls.par")
+TEST_PAR = os.path.join(DATA_DIR, "test_parfile.par")
+
+
+class TestEphemeris:
+    def test_sun_position_against_meeus(self):
+        # Meeus, Astronomical Algorithms, example 25.b: 1992 Oct 13.0 TD
+        # (JDE 2448908.5): geometric solar longitude 199.907372 deg (of
+        # date), R = 0.99760775 AU
+        mjd = 2448908.5 - 2400000.5
+        lon, lat, rad = ephem.earth_heliocentric(mjd)
+        sun_lon = np.degrees((lon + np.pi) % (2 * np.pi))
+        assert abs(sun_lon - 199.907372) * 3600 < 3.0  # arcsec
+        assert abs(rad - 0.99760775) < 2e-6  # AU
+        assert abs(np.degrees(lat) * 3600) < 2.0  # |b| < 2 arcsec
+
+    def test_earth_orbital_speed(self):
+        r1, _ = ephem.observatory_ssb(56000.0, "coe")
+        r2, _ = ephem.observatory_ssb(56000.01, "coe")
+        v = np.linalg.norm(r2 - r1) / (0.01 * 86400) * 299792.458
+        assert 29.0 < v < 30.6  # km/s
+
+    def test_sun_ssb_offset_scale(self):
+        # the Sun orbits the SSB within ~2.2 solar radii (= 5.1 lt-s);
+        # Jupiter alone contributes 2.5 lt-s
+        for mjd in (50000.0, 55000.0, 57000.0):
+            off = np.linalg.norm(ephem.sun_ssb_offset(mjd)) * ephem.AU_LTS
+            assert 0.1 < off < 5.2
+
+    def test_gmst_at_j2000(self):
+        # 2000-01-01 12h UT: GMST = 280.46061837 deg
+        assert np.degrees(ephem._gmst_rad(51544.5)) == pytest.approx(
+            280.46061837, abs=1e-6)
+
+    def test_leap_seconds(self):
+        assert list(ephem.tai_minus_utc([50082, 50083, 57203, 57204,
+                                         58000])) == [29, 30, 35, 36, 37]
+
+    def test_tdb_offset_no_cancellation(self):
+        # offset-in-seconds path must be smooth at the 1e-9 s level where
+        # the naive MJD difference quantizes at ~0.6 us
+        t = 55400.0 + np.linspace(0, 0.04, 100)
+        off = ephem.tdb_minus_utc_seconds(t)
+        assert np.all(np.abs(np.diff(off, 2)) < 1e-9)
+        assert 66.0 < off[0] < 70.0  # 34 leap + 32.184 + periodic terms
+
+    def test_observatory_positions(self):
+        robs, _ = ephem.observatory_ssb(56000.0, "1")
+        rgeo, _ = ephem.observatory_ssb(56000.0, "coe")
+        radius_km = np.linalg.norm(robs - rgeo) * 299792.458
+        assert radius_km == pytest.approx(6370.7, abs=5.0)  # GBT geocentric radius
+        with pytest.raises(ephem.UnknownObservatoryError):
+            ephem.observatory_itrf("not-a-site")
+
+    def test_kepler_solver(self):
+        M = np.linspace(-np.pi, np.pi, 101)
+        for e in (0.0, 0.1, 0.6, 0.95):
+            E = ephem.solve_kepler(M, e)
+            assert np.max(np.abs(E - e * np.sin(E) - M)) < 1e-12
+
+
+class TestTimingModel:
+    def test_parses_real_nanograv_par(self):
+        m = TimingModel.from_par(J1713_PAR)  # strict default
+        assert m.binary == "DDK"
+        assert m.a1 == pytest.approx(32.342422803)
+        assert m.sini == pytest.approx(np.sin(np.radians(71.969)))
+        assert len(m.dmx_val) == 69 or len(m.dmx_val) > 50
+        assert len(m.fd_terms) == 5
+        assert m.tzrsite == "1"
+
+    def test_phase_zero_at_tzr(self):
+        for par in (J1713_PAR, J1910_PAR, TEST_PAR):
+            m = TimingModel.from_par(par)
+            ph = m.phase(np.atleast_1d(np.longdouble(m.tzrmjd)))
+            assert abs(float(ph[0])) < 1e-7
+
+    def test_spin_phase_advances_one_cycle_per_period(self):
+        # isolated barycentric par: exactly F0 cycles per second
+        m = TimingModel.from_par(TEST_PAR)
+        f0 = float(m.f_terms[0])
+        t0 = np.longdouble(56000.1)
+        t1 = t0 + np.longdouble(1.0 / f0) / np.longdouble(86400.0)
+        d = m.phase(np.asarray([t0, t1], np.longdouble))
+        # longdouble MJD quantizes at ~5e-10 s near MJD 56000, i.e.
+        # ~1e-7 cycles at F0 = 186 Hz — that is the representation floor
+        assert float(d[1] - d[0]) == pytest.approx(1.0, abs=2e-7)
+
+    def test_apparent_frequency_doppler_bounded(self):
+        # topocentric apparent spin frequency differs from F0 by Earth
+        # orbital+rotation Doppler (~1e-4) plus binary Doppler (~1e-4)
+        m = TimingModel.from_par(J1713_PAR)
+        f0 = float(m.f_terms[0])
+        for mjd in (55400.0, 55500.0, 55600.0):
+            fapp = m.apparent_spin_freq(mjd)
+            assert abs(fapp / f0 - 1.0) < 3e-4
+
+    def test_binary_delay_amplitude_and_period(self):
+        m = TimingModel.from_par(J1713_PAR)
+        t = np.linspace(55400, 55400 + 2 * m.pb, 4000)
+        d = m.binary_delay(t)
+        # Roemer amplitude ~ A1 (low eccentricity)
+        assert np.max(d) == pytest.approx(m.a1, rel=0.01)
+        assert np.min(d) == pytest.approx(-m.a1, rel=0.01)
+        # periodic with PB
+        d2 = m.binary_delay(t + m.pb)
+        assert np.max(np.abs(d2 - d)) < 1e-3  # slow OMDOT drift only
+
+    def test_ell1_conversion_matches_dd_small_e(self, tmp_path):
+        # the same low-eccentricity orbit expressed in ELL1 (EPS1/EPS2/
+        # TASC) and DD (ECC/OM/T0) parameters must give the same delay
+        pb, a1, ecc, om_deg, tasc = 10.0, 5.0, 3e-4, 40.0, 56000.0
+        om = np.radians(om_deg)
+        t0 = tasc + om / (2 * np.pi) * pb
+        base = ("PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\n"
+                "F0 100.0\nPEPOCH 56000\nDM 10.0\n"
+                "TZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n")
+        ell1 = tmp_path / "ell1.par"
+        ell1.write_text(base + f"BINARY ELL1\nPB {pb}\nA1 {a1}\n"
+                        f"TASC {tasc}\nEPS1 {ecc*np.sin(om)}\n"
+                        f"EPS2 {ecc*np.cos(om)}\n")
+        dd = tmp_path / "dd.par"
+        dd.write_text(base + f"BINARY DD\nPB {pb}\nA1 {a1}\n"
+                      f"T0 {t0}\nECC {ecc}\nOM {om_deg}\n")
+        m1 = TimingModel.from_par(str(ell1))
+        m2 = TimingModel.from_par(str(dd))
+        t = np.linspace(56000, 56000 + 2 * pb, 500)
+        assert np.max(np.abs(m1.binary_delay(t) - m2.binary_delay(t))) < 1e-9
+
+    def test_dmx_piecewise(self):
+        m = TimingModel.from_par(J1713_PAR)
+        # inside the first DMX range the DM shifts by DMX_0001
+        r1, r2, v = m.dmx_r1[0], m.dmx_r2[0], m.dmx_val[0]
+        mid = 0.5 * (r1 + r2)
+        assert m.dm_at(mid) == pytest.approx(m.dm + v, abs=1e-9)
+        assert m.dm_at(r1 - 10.0) != pytest.approx(m.dm + v, abs=abs(v) / 2)
+
+    def test_strict_rejects_glitch_and_tcb(self, tmp_path):
+        base = ("PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\nF0 100.0\n"
+                "PEPOCH 56000\nDM 10.0\nTZRSITE @\n")
+        for extra in ("GLEP_1 55000.0\n", "UNITS TCB\n", "BINARY T2\n"):
+            par = tmp_path / "bad.par"
+            par.write_text(base + extra)
+            with pytest.raises(UnsupportedTimingModelError):
+                TimingModel.from_par(str(par))
+            # non-strict builds the model from the supported subset
+            TimingModel.from_par(str(par), strict=False)
+
+    def test_parse_par_full_longdouble_epochs(self):
+        p = parse_par_full(J1713_PAR)
+        assert isinstance(p["TZRMJD"], np.longdouble)
+        assert p["TZRSITE"] == "1"
+        assert isinstance(p["F0"], float)
+
+
+class TestPolycoFit:
+    @pytest.mark.parametrize("par,start", [
+        (J1713_PAR, 55400.0),
+        (J1910_PAR, 56131.3),
+        (TEST_PAR, 55999.9861),
+    ])
+    def test_fit_matches_model_below_1e6_cycles(self, par, start):
+        # THE acceptance criterion: strict polyco on the real NANOGrav
+        # pars, fit-vs-model agreement < 1e-6 cycles across the span
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # the >1e-6 residual warning fails the test
+            pc = generate_polyco(par, start, segLength=60.0, ncoeff=15)
+        model = TimingModel.from_par(par)
+        t = np.longdouble(start) + np.linspace(
+            0, 60.0 / 1440.0, 601).astype(np.longdouble)
+        direct = model.phase(t)
+        dt_min = np.asarray((t - np.longdouble(pc["REF_MJD"])) * 1440.0,
+                            np.float64)
+        pred = (pc["REF_PHS"]
+                + np.polynomial.polynomial.polyval(dt_min, pc["COEFF"])
+                + 60.0 * pc["REF_F0"] * dt_min)
+        err = np.asarray(direct, np.float64) - pred
+        err -= np.round(err[300])  # common integer-cycle origin
+        assert np.max(np.abs(err)) < 1e-6
+
+    def test_site_and_freq_overrides(self):
+        pc = generate_polyco(J1713_PAR, 55400.0, obs_freq=1400.0, site="1")
+        assert pc["REF_FREQ"] == 1400.0
+        assert pc["NSITE"] == b"1"
+
+    def test_polyco_freq_dependence_is_dispersive(self):
+        # REF_PHS at two frequencies must differ by the cold-plasma delay
+        # times F0 (modulo integer cycles)
+        m = TimingModel.from_par(J1910_PAR)
+        f0 = float(m.f_terms[0])
+        start = 56131.3
+        lo = generate_polyco(J1910_PAR, start, obs_freq=1400.0)
+        hi = generate_polyco(J1910_PAR, start, obs_freq=2000.0)
+        dm = m.dm_at(start + 30.0 / 1440.0)
+        dt = dm / 2.41e-4 * (1.0 / 1400.0**2 - 1.0 / 2000.0**2)
+
+        def fd(f_mhz):
+            return sum(c * np.log(f_mhz / 1000.0) ** i
+                       for i, c in enumerate(m.fd_terms, start=1))
+
+        # lower frequency -> larger subtracted delay -> smaller phase;
+        # the FD (profile-evolution) terms ride along with dispersion
+        expect = -(dt + fd(1400.0) - fd(2000.0)) * f0
+        got = lo["REF_PHS"] - hi["REF_PHS"]
+        frac_diff = (got - expect + 0.5) % 1.0 - 0.5
+        assert abs(frac_diff) < 1e-3
