@@ -22,6 +22,7 @@ under ``strict=True`` rather than mispredicting silently.
 
 from __future__ import annotations
 
+import os
 import warnings
 
 import numpy as np
@@ -31,6 +32,9 @@ from .timing import (TimingModel, UnsupportedTimingModelError,
 
 __all__ = ["parse_par", "generate_polyco", "polyco_phase",
            "UnsupportedTimingModelError", "check_par_supported"]
+
+# (par fingerprint, fit args) -> polyco dict; see generate_polyco
+_POLYCO_CACHE = {}
 
 
 def check_par_supported(params, parfile="<par>"):
@@ -82,6 +86,21 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
         REF_FREQ, NSITE, REF_F0, COEFF, REF_MJD, REF_PHS — mirroring the
         reference's polyco_dict (io/psrfits.py:144-177).
     """
+    # bulk exports fit the same polyco for thousands of files; memoize on
+    # the par file's identity (path + mtime + size) and the fit arguments
+    try:
+        st = os.stat(parfile)
+        cache_key = (os.path.realpath(parfile), st.st_mtime_ns, st.st_size,
+                     float(MJD_start), float(segLength), int(ncoeff),
+                     bool(strict),
+                     None if obs_freq is None else float(obs_freq),
+                     None if site is None else str(site))
+    except OSError:
+        cache_key = None
+    if cache_key is not None and cache_key in _POLYCO_CACHE:
+        hit = _POLYCO_CACHE[cache_key]
+        return {**hit, "COEFF": hit["COEFF"].copy()}
+
     model = TimingModel.from_par(parfile, strict=strict)
     f0 = float(model.f_terms[0])
     if site is None:
@@ -117,12 +136,12 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
     resid = np.asarray(phases - phase_mid - lin, np.float64)
 
     deg = min(ncoeff - 1, nnodes - 1)
-    cheb = np.polynomial.chebyshev.Chebyshev.fit(
-        dt_min / half_min, resid, deg, domain=[-1.0, 1.0])
-    poly = cheb.convert(kind=np.polynomial.Polynomial)
+    cheb_coef = np.polynomial.chebyshev.chebfit(
+        dt_min / half_min, resid, deg)
+    poly_coef = np.polynomial.chebyshev.cheb2poly(cheb_coef)
     coeffs = np.zeros(ncoeff, np.float64)
-    scale = np.power(half_min, -np.arange(deg + 1, dtype=np.float64))
-    coeffs[:deg + 1] = poly.coef * scale
+    scale = np.power(half_min, -np.arange(len(poly_coef), dtype=np.float64))
+    coeffs[:len(poly_coef)] = poly_coef * scale
 
     fit = np.polynomial.polynomial.polyval(dt_min, coeffs)
     fit_err = float(np.max(np.abs(fit - resid)))
@@ -134,7 +153,7 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
 
     ref_phs = np.float64(phase_mid - np.floor(phase_mid))
 
-    return {
+    result = {
         "NSPAN": segLength,
         "NCOEF": ncoeff,
         "REF_FREQ": ref_freq,
@@ -144,6 +163,11 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
         "REF_MJD": np.double(tmid),
         "REF_PHS": np.double(ref_phs),
     }
+    if cache_key is not None:
+        if len(_POLYCO_CACHE) > 256:
+            _POLYCO_CACHE.clear()
+        _POLYCO_CACHE[cache_key] = {**result, "COEFF": coeffs.copy()}
+    return result
 
 
 def polyco_phase(polyco, mjd):

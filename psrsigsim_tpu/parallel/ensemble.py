@@ -210,7 +210,7 @@ class FoldEnsemble:
 
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
                     noise_norms=None, quantized=False, progress=None,
-                    skip_chunk=None):
+                    skip_chunk=None, prefetch=1):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -234,22 +234,30 @@ class FoldEnsemble:
         when it returns True the chunk's device computation is skipped
         entirely and nothing is yielded for it (progress still advances).
         This is how resuming exporters avoid re-simulating finished work.
+
+        ``prefetch``: how many chunks to keep in flight on the device ahead
+        of the one being fetched (default 1).  JAX dispatch is async, so
+        with ``prefetch >= 1`` the device computes chunk N+1 while chunk N
+        crosses the host link and while the consumer (e.g. the PSRFITS
+        exporter) writes files — the transfer/compute overlap that takes
+        the end-to-end export off the serial dispatch->fetch->write path.
+        Each in-flight chunk holds one extra output buffer on device;
+        ``prefetch=0`` restores strictly serial behavior.
         """
         self._validate_per_obs(n_obs, dms, noise_norms)
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
+        if prefetch < 0:
+            raise ValueError("prefetch must be >= 0")
         if n_obs <= 0:
             return
         chunk_size = min(chunk_size, n_obs)
         n_obs_shards = self.mesh.shape[OBS_AXIS]
         chunk_size += (-chunk_size) % n_obs_shards
 
-        for start in range(0, n_obs, chunk_size):
-            count = min(chunk_size, n_obs - start)
-            if skip_chunk is not None and skip_chunk(start, count):
-                if progress is not None:
-                    progress(min(start + count, n_obs), n_obs)
-                continue
+        def _dispatch(start, count):
+            """Launch one chunk asynchronously; returns device futures
+            already trimmed to ``count`` observations."""
             idx = (start + np.arange(chunk_size)) % n_obs
             keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
                                                     noise_norms)
@@ -258,17 +266,44 @@ class FoldEnsemble:
                     keys, dms_c, norms_c, self._profiles, self._freqs,
                     self._chan_ids,
                 )
-                block = (np.asarray(d[:count]), np.asarray(s[:count]),
-                         np.asarray(o[:count]))
-            else:
-                out = self._run_sharded(
-                    keys, dms_c, norms_c, self._profiles, self._freqs,
-                    self._chan_ids,
-                )
-                block = np.asarray(out[:count])
+                return (d[:count], s[:count], o[:count])
+            out = self._run_sharded(
+                keys, dms_c, norms_c, self._profiles, self._freqs,
+                self._chan_ids,
+            )
+            return out[:count]
+
+        def _fetch(dev_block):
+            # one batched device->host copy per chunk (device_get on the
+            # whole pytree), not one transfer per array
+            return jax.device_get(dev_block)
+
+        done_max = 0
+
+        def _report(done):
+            # skipped chunks can run ahead of in-flight ones; keep the
+            # user-visible counter monotonic
+            nonlocal done_max
+            done_max = max(done_max, min(done, n_obs))
             if progress is not None:
-                progress(min(start + count, n_obs), n_obs)
-            yield start, block
+                progress(done_max, n_obs)
+
+        inflight = []  # [(start, count, device futures)]
+        for start in range(0, n_obs, chunk_size):
+            count = min(chunk_size, n_obs - start)
+            if skip_chunk is not None and skip_chunk(start, count):
+                _report(start + count)
+                continue
+            inflight.append((start, count, _dispatch(start, count)))
+            if len(inflight) > prefetch:
+                s0, _, dev = inflight.pop(0)
+                block = _fetch(dev)
+                _report(s0 + chunk_size)
+                yield s0, block
+        for s0, _, dev in inflight:
+            block = _fetch(dev)
+            _report(s0 + chunk_size)
+            yield s0, block
 
     def signal_shell(self):
         """The configured signal object (metadata only — no ensemble data

@@ -94,3 +94,56 @@ class TestExport:
         assert calls[-1] == (4, 4)
         # no temp files left behind
         assert not [p for p in os.listdir(out) if p.endswith(".tmp")]
+
+
+class TestWriterPoolAndManifest:
+    def test_parallel_writers_byte_identical_to_serial(self, ens, tmp_path):
+        # the spawn-worker + shared-memory path must produce exactly the
+        # files the in-process path does
+        a = str(tmp_path / "serial")
+        b = str(tmp_path / "pool")
+        dms = np.linspace(9.0, 11.0, 5)
+        pa = export_ensemble_psrfits(ens, 5, a, TEMPLATE, ens.pulsar,
+                                     seed=4, dms=dms, chunk_size=4,
+                                     writers=1)
+        pb = export_ensemble_psrfits(ens, 5, b, TEMPLATE, ens.pulsar,
+                                     seed=4, dms=dms, chunk_size=4,
+                                     writers=2)
+        for fa, fb in zip(pa, pb):
+            da, db = open(fa, "rb").read(), open(fb, "rb").read()
+            assert da == db, os.path.basename(fa)
+
+    def test_manifest_blocks_mismatched_resume(self, ens, tmp_path):
+        from psrsigsim_tpu.io.export import ExportManifestError
+
+        out = str(tmp_path / "m")
+        export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                                chunk_size=2)
+        # same params resume fine
+        export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                                chunk_size=2)
+        # different seed: refuse rather than silently keep stale files
+        with pytest.raises(ExportManifestError):
+            export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar,
+                                    seed=2, chunk_size=2)
+        # resume=False overwrites and rewrites the manifest
+        export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar, seed=2,
+                                chunk_size=2, resume=False)
+
+    def test_manifest_covers_noise_norms_and_template_content(self, ens,
+                                                              tmp_path):
+        from psrsigsim_tpu.io.export import ExportManifestError
+
+        out = str(tmp_path / "nn")
+        nn = np.full(2, 0.5, np.float64)
+        # str path and parsed FitsFile of the SAME template must agree
+        export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                                chunk_size=2, noise_norms=nn)
+        export_ensemble_psrfits(ens, 2, out, FitsFile.read(TEMPLATE),
+                                ens.pulsar, seed=1, chunk_size=2,
+                                noise_norms=nn)
+        # different noise_norms: refuse
+        with pytest.raises(ExportManifestError):
+            export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar,
+                                    seed=1, chunk_size=2,
+                                    noise_norms=nn * 2.0)

@@ -98,6 +98,35 @@ class TestIterChunks:
         assert list(ens.iter_chunks(0)) == []
         with pytest.raises(ValueError):
             list(ens.iter_chunks(8, chunk_size=0))
+        with pytest.raises(ValueError):
+            list(ens.iter_chunks(8, prefetch=-1))
+
+    def test_prefetch_depths_bit_identical(self, ens):
+        # the overlap pipeline (dispatch chunk N+1 before fetching chunk N)
+        # must not change bytes, ordering, or chunk boundaries
+        n = 10
+        runs = {}
+        for pf in (0, 1, 3):
+            runs[pf] = list(ens.iter_chunks(n, chunk_size=4, seed=9,
+                                            prefetch=pf))
+        starts0 = [s for s, _ in runs[0]]
+        for pf in (1, 3):
+            assert [s for s, _ in runs[pf]] == starts0
+            for (_, a), (_, b) in zip(runs[0], runs[pf]):
+                assert np.array_equal(a, b)
+
+    def test_prefetch_respects_skip_and_monotonic_progress(self, ens):
+        n = 12
+        calls = []
+        seen = []
+        for start, block in ens.iter_chunks(
+            n, chunk_size=4, seed=1, prefetch=2,
+            skip_chunk=lambda s, c: s == 4,
+            progress=lambda d, t: calls.append(d),
+        ):
+            seen.append(start)
+        assert 4 not in seen and seen == sorted(seen)
+        assert calls == sorted(calls)  # monotonic despite skip interleave
 
     def test_per_obs_dms_align_with_global_index(self, ens):
         n = 8
