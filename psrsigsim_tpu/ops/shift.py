@@ -71,7 +71,7 @@ def fourier_shift(data, shifts, dt=1.0):
 
     n = data.shape[-1]
 
-    if _is_concrete(shifts):
+    if _is_concrete(shifts) and _is_concrete(dt):
         freqs = np.fft.rfftfreq(n, d=float(dt))
         cycles = np.mod(freqs * np.asarray(shifts, np.float64)[..., None], 1.0)
         re = np.cos(2 * np.pi * cycles).astype(np.float32)

@@ -328,14 +328,18 @@ class MultiPulsarFoldEnsemble:
     (128 pulsars x 1000 epochs; reference semantics per observation:
     pulsar/pulsar.py:196-221).
 
-    Strategy (TPU-native): pulsars are **nph-bucketed** — grouped by static
-    geometry ``(Nchan, Nph, nsub, dt)`` so each bucket is ONE compiled
+    Strategy (TPU-native): pulsars are **nbin-bucketed** — grouped by the
+    static geometry ``(Nchan, Nph, nsub)`` so each bucket is ONE compiled
     shard_map program; within a bucket every pulsar-specific quantity
     (portrait, DM, chi2 df ``nfold``, draw norm, noise norm, channel
-    frequencies) is a traced per-pulsar input via
-    :func:`~psrsigsim_tpu.simulate.fold_pipeline_hetero`.  Pulsars shard
-    over the mesh ``obs`` axis, channels over ``chan``; epochs vmap inside
-    each shard.
+    frequencies, sample spacing ``dt``) is a traced per-pulsar input via
+    :func:`~psrsigsim_tpu.simulate.fold_pipeline_hetero`.  With
+    ``pad_nbin`` in :meth:`from_simulations`, pulsars with DISTINCT
+    periods land on a common phase resolution (the standard PSRFITS
+    practice of a shared NBIN) and differ only in the traced ``dt`` — so
+    128 distinct periods compile O(1) programs instead of 128.  Pulsars
+    shard over the mesh ``obs`` axis, channels over ``chan``; epochs vmap
+    inside each shard.
 
     Randomness is keyed by (seed, global pulsar index, epoch), so results
     are bit-identical for any mesh shape and any bucketing.
@@ -350,9 +354,15 @@ class MultiPulsarFoldEnsemble:
     mesh : jax.sharding.Mesh, optional
     """
 
-    def __init__(self, workloads, mesh=None):
+    def __init__(self, workloads, mesh=None, epoch_chunk=None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.workloads = list(workloads)
+        # epoch_chunk bounds the chi2-sampler working set: epochs are
+        # processed epoch_chunk at a time through lax.map inside ONE
+        # compiled program, so large-epoch calls fit HBM (the sampler's
+        # rejection temporaries scale with pulsars x in-flight epochs x
+        # nsamp).  None = plain vmap over all epochs.
+        self.epoch_chunk = epoch_chunk
         n_chan_shards = self.mesh.shape[CHAN_AXIS]
 
         self._buckets = {}  # static geometry -> list of pulsar indices
@@ -362,25 +372,64 @@ class MultiPulsarFoldEnsemble:
                     f"pulsar {idx}: Nchan={cfg.meta.nchan} must be divisible "
                     f"by the chan mesh axis ({n_chan_shards})"
                 )
-            bkey = (cfg.meta.nchan, cfg.nph, cfg.nsub, cfg.dt_ms)
+            bkey = (cfg.meta.nchan, cfg.nph, cfg.nsub)
             self._buckets.setdefault(bkey, []).append(idx)
 
         self._compiled = {}  # (bucket key, epochs) -> jitted sharded program
         self._bucket_data = {}  # bucket key -> staged device inputs
 
+    @staticmethod
+    def choose_nbin(nph_natural, pad_nbin):
+        """Resolve a pulsar's padded phase resolution.
+
+        ``pad_nbin`` may be ``"pow2"`` (next power of two >= the natural
+        ``int(samprate * period)``), an int (one common NBIN for all), or
+        a sorted iterable of ceilings (smallest ceiling >= natural; the
+        largest ceiling is used — with a warning-free clamp — when the
+        natural resolution exceeds every ceiling, which only coarsens the
+        phase grid the way a common-NBIN fold would)."""
+        if isinstance(pad_nbin, str):
+            if pad_nbin == "pow2":
+                return 1 << max(0, int(np.ceil(np.log2(max(1, nph_natural)))))
+            raise ValueError(
+                f"pad_nbin={pad_nbin!r}: the only string mode is 'pow2' "
+                "(pass an int or a grid of ceilings otherwise)")
+        if isinstance(pad_nbin, (int, np.integer)):
+            return int(pad_nbin)
+        grid = sorted(int(g) for g in pad_nbin)
+        if not grid:
+            raise ValueError("pad_nbin grid is empty")
+        for g in grid:
+            if g >= nph_natural:
+                return g
+        return grid[-1]
+
     @classmethod
-    def from_simulations(cls, sims, mesh=None):
+    def from_simulations(cls, sims, mesh=None, pad_nbin=None,
+                         epoch_chunk=None):
         """Build from configured :class:`Simulation` objects (one per
-        pulsar): runs ``init_all`` + ``build_fold_config`` on each."""
+        pulsar): runs ``init_all`` + ``build_fold_config`` on each.
+
+        ``pad_nbin``: see :meth:`choose_nbin`.  ``None`` keeps every
+        pulsar's natural ``int(samprate * period)`` resolution (one bucket
+        per distinct period).  ``epoch_chunk``: forwarded to the
+        constructor — required for large-epoch runs of padded populations,
+        whose big bucket would otherwise blow HBM."""
+        from ..simulate.pipeline import natural_nbin
+
         workloads = []
         for s in sims:
             s.init_all()
+            nbin = None
+            if pad_nbin is not None:
+                nbin = cls.choose_nbin(natural_nbin(s.signal, s.pulsar),
+                                       pad_nbin)
             cfg, profiles, noise_norm = build_fold_config(
-                s.signal, s.pulsar, s.tscope, s.system_name
+                s.signal, s.pulsar, s.tscope, s.system_name, nbin=nbin
             )
             dm = float(s.signal.dm.value) if s.signal.dm is not None else 0.0
             workloads.append((cfg, profiles, noise_norm, dm))
-        return cls(workloads, mesh=mesh)
+        return cls(workloads, mesh=mesh, epoch_chunk=epoch_chunk)
 
     @property
     def n_buckets(self):
@@ -393,20 +442,28 @@ class MultiPulsarFoldEnsemble:
             return self._compiled[cache_key]
         mesh = self.mesh
 
-        def _local(keys, dms, norms, nfolds, draw_norms, profiles, freqs,
-                   chan_ids):
+        epoch_chunk = self.epoch_chunk
+
+        def _local(keys, dms, norms, nfolds, draw_norms, dts, profiles,
+                   freqs, chan_ids):
             # keys (P_loc, E); per-pulsar params (P_loc, ...); profiles
             # (P_loc, C_loc, Nph); freqs (P_loc, C_loc); chan_ids (C_loc,)
-            def per_pulsar(krow, d, n, f, dn, prof, fr):
-                return jax.vmap(
-                    lambda k: fold_pipeline_hetero(
+            def per_pulsar(krow, d, n, f, dn, dt, prof, fr):
+                def one_epoch(k):
+                    return fold_pipeline_hetero(
                         k, d, n, f, dn, prof, cfg, freqs=fr,
-                        chan_ids=chan_ids,
+                        chan_ids=chan_ids, dt_ms=dt,
                     )
-                )(krow)
+
+                if epoch_chunk is None:
+                    return jax.vmap(one_epoch)(krow)
+                # chunked epochs: same draws (keys are per-epoch), bounded
+                # temporaries
+                return jax.lax.map(one_epoch, krow,
+                                   batch_size=min(epoch_chunk, epochs))
 
             return jax.vmap(per_pulsar)(
-                keys, dms, norms, nfolds, draw_norms, profiles, freqs
+                keys, dms, norms, nfolds, draw_norms, dts, profiles, freqs
             )
 
         prog = jax.jit(
@@ -419,6 +476,7 @@ class MultiPulsarFoldEnsemble:
                     P(OBS_AXIS),                 # noise norms
                     P(OBS_AXIS),                 # nfolds
                     P(OBS_AXIS),                 # draw norms
+                    P(OBS_AXIS),                 # dt_ms (per-pulsar spacing)
                     P(OBS_AXIS, CHAN_AXIS, None),  # profiles
                     P(OBS_AXIS, CHAN_AXIS),      # freqs
                     P(CHAN_AXIS),                # chan ids
@@ -460,6 +518,9 @@ class MultiPulsarFoldEnsemble:
                            np.float32), obs_sh),
             draw_norms=jax.device_put(
                 np.asarray([self.workloads[i][0].draw_norm for i in padded],
+                           np.float32), obs_sh),
+            dts=jax.device_put(
+                np.asarray([self.workloads[i][0].dt_ms for i in padded],
                            np.float32), obs_sh),
             profiles=jax.device_put(
                 np.stack([np.asarray(self.workloads[i][1], np.float32)
@@ -512,7 +573,7 @@ class MultiPulsarFoldEnsemble:
             prog = self._program(bkey, cfg0, epochs)
             out = prog(
                 keys, st["dms"], st["norms"], st["nfolds"], st["draw_norms"],
-                st["profiles"], st["freqs"], st["chan_ids"],
+                st["dts"], st["profiles"], st["freqs"], st["chan_ids"],
             )
             for slot, idx in enumerate(members):
                 results[idx] = out[slot]

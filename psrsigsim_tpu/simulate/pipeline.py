@@ -147,9 +147,10 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
 
 
 def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
-               freqs, chan_ids, extra_delays_ms):
+               freqs, chan_ids, extra_delays_ms, dt_ms=None):
     """Shared fold-mode observation body (synthesis + dispersion + noise);
-    pulsar parameters may be static (homogeneous path) or traced (hetero)."""
+    pulsar parameters may be static (homogeneous path) or traced (hetero,
+    including the sample spacing ``dt_ms``)."""
     kp = stage_key(key, "pulse")
     kn = stage_key(key, "noise")
     if freqs is None:
@@ -165,7 +166,8 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
 
     # dispersion (+ FD/scatter) as ONE batched shift (reference ism.py:40-74)
     delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
-    block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
+    block = fourier_shift(block, delays_ms,
+                          dt=cfg.dt_ms if dt_ms is None else dt_ms)
 
     # radiometer noise (reference: receiver.py:140-172)
     return block + _chan_chi2(kn, chan_ids, noise_df, nsamp) * noise_norm
@@ -173,22 +175,26 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
 
 @partial(jax.jit, static_argnames=("cfg",))
 def fold_pipeline_hetero(key, dm, noise_norm, nfold, draw_norm, profiles, cfg,
-                         freqs=None, chan_ids=None, extra_delays_ms=None):
+                         freqs=None, chan_ids=None, extra_delays_ms=None,
+                         dt_ms=None):
     """Fold-mode observation with PER-OBSERVATION pulsar parameters traced:
-    portrait, DM, chi2 df (``nfold = sublen/period``), draw norm, noise norm
-    and channel frequencies are all inputs, so observations of DIFFERENT
-    pulsars that share static geometry ``(Nchan, Nph, nsub, dt)`` run
-    through ONE compiled program (the nph-bucketing strategy of
-    :class:`~psrsigsim_tpu.parallel.MultiPulsarFoldEnsemble`).
+    portrait, DM, chi2 df (``nfold = sublen/period``), draw norm, noise norm,
+    channel frequencies AND the sample spacing ``dt_ms`` are all inputs, so
+    observations of DIFFERENT pulsars that share static geometry
+    ``(Nchan, Nph, nsub)`` run through ONE compiled program (the
+    pad-to-common-nbin strategy of
+    :class:`~psrsigsim_tpu.parallel.MultiPulsarFoldEnsemble`: distinct
+    periods at a common phase resolution differ only in dt).
 
     In fold mode the radiometer-noise chi2 df equals ``nfold``
     (reference: receiver.py:163-164), so it is traced here too.
 
-    Args: as :func:`fold_pipeline` plus traced ``nfold``/``draw_norm``.
+    Args: as :func:`fold_pipeline` plus traced ``nfold``/``draw_norm`` and
+    optional traced ``dt_ms`` (defaults to the static ``cfg.dt_ms``).
     Returns ``(Nchan, nsub*Nph)`` float32.
     """
     return _fold_core(key, dm, noise_norm, nfold, draw_norm, nfold, profiles,
-                      cfg, freqs, chan_ids, extra_delays_ms)
+                      cfg, freqs, chan_ids, extra_delays_ms, dt_ms=dt_ms)
 
 
 def fold_pipeline_batch(cfg, shared_profiles=True):
@@ -201,19 +207,40 @@ def fold_pipeline_batch(cfg, shared_profiles=True):
     return batched
 
 
-def build_fold_config(signal, pulsar, telescope, system, Tsys=None):
+def natural_nbin(signal, pulsar):
+    """Phase bins per period at the signal's sample rate —
+    ``int(samprate * period)``, the reference's resolution rule
+    (pulsar.py:124).  The single source of truth shared by
+    :func:`build_fold_config`, the multi-pulsar bucketing, and the bench."""
+    return int((signal.samprate * pulsar.period).decompose())
+
+
+def build_fold_config(signal, pulsar, telescope, system, Tsys=None,
+                      nbin=None):
     """Derive the static config + host inputs for the functional pipeline
     from configured OO objects (without generating any data).
 
     Returns ``(cfg, profiles_np, noise_norm)``: feed ``profiles_np`` and a
     per-observation ``noise_norm`` (scale with Smean if it varies) into
     :func:`fold_pipeline`.
+
+    ``nbin``: override the phase resolution.  By default one period spans
+    ``int(samprate * period)`` bins (reference: pulsar.py:124); with
+    ``nbin`` the portrait is evaluated at exactly ``nbin`` phase bins and
+    the effective sample spacing becomes ``period / nbin`` — the standard
+    PSRFITS practice of folding every pulsar to a common NBIN, and what
+    lets :class:`~psrsigsim_tpu.parallel.MultiPulsarFoldEnsemble` run
+    heterogeneous periods through a handful of compiled programs.
+    Downstream statistics (radiometer noise dt, draw norms) follow the
+    padded spacing automatically.
     """
     if not signal.fold:
         raise ValueError("build_fold_config requires a fold-mode FilterBankSignal")
 
     period_s = float(pulsar.period.to("s").value)
-    nph = int((signal.samprate * pulsar.period).decompose())
+    nph = int(nbin) if nbin is not None else natural_nbin(signal, pulsar)
+    if nph <= 0:
+        raise ValueError(f"nbin={nbin} must be positive")
     tobs = signal.tobs
     if tobs is None:
         raise ValueError("set signal._tobs (or pass tobs through Simulation) first")
@@ -235,9 +262,15 @@ def build_fold_config(signal, pulsar, telescope, system, Tsys=None):
     pr = pulsar.Profiles._max_profile
     signal._Smax = pulsar.Smean * len(pr) / float(np.sum(pr))
 
-    # mirror the signal bookkeeping make_pulses would do
+    # mirror the signal bookkeeping make_pulses would do; under an nbin
+    # override nsamp follows the padded resolution (and with it the noise
+    # dt the receiver derives from sublen/(nsamp/nsub))
     signal._nsub = nsub
-    signal._nsamp = int(nsub * period_s * float(signal.samprate.to("MHz").value) * 1e6)
+    if nbin is None:
+        signal._nsamp = int(nsub * period_s
+                            * float(signal.samprate.to("MHz").value) * 1e6)
+    else:
+        signal._nsamp = nsub * nph
     signal._Nfold = nfold
     signal._set_draw_norm(df=nfold)
     if signal.sublen is None:
@@ -247,6 +280,11 @@ def build_fold_config(signal, pulsar, telescope, system, Tsys=None):
     tsys = rcvr._resolve_tsys(Tsys if Tsys is not None else telescope.Tsys, None)
     noise_norm, noise_df = rcvr._pow_noise_norm(signal, tsys, telescope.gain, pulsar)
 
+    if nbin is None:
+        dt_ms = float((1 / signal.samprate).to("ms").value)
+    else:
+        dt_ms = period_s * 1e3 / nph  # padded effective sample spacing
+
     cfg = FoldPipelineConfig(
         meta=signal.meta(),
         period_s=period_s,
@@ -255,7 +293,7 @@ def build_fold_config(signal, pulsar, telescope, system, Tsys=None):
         nfold=float(nfold),
         draw_norm=float(signal._draw_norm),
         noise_df=float(noise_df),
-        dt_ms=float((1 / signal.samprate).to("ms").value),
+        dt_ms=dt_ms,
         clip_max=float(signal._draw_max),
     )
     return cfg, profiles_np, float(noise_norm)
