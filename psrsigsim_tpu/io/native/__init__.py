@@ -30,22 +30,50 @@ __all__ = ["available", "encode_available", "encode_subints",
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "encode.cpp")
-_SO = os.path.join(_HERE, "_native.so")
 
-_lock = threading.Lock()
+# reentrant: encode_available() probes encode_subints() -> _load() while
+# holding the lock
+_lock = threading.RLock()
 _lib = None
 _tried = False
 
 
-def _build():
+def _src_tag():
+    """Content hash of encode.cpp: the library filename embeds it, so a
+    changed source (package upgrade) can never silently load a stale
+    binary — no mtime heuristics (wheel-archived mtimes lie)."""
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]
+
+
+def _so_candidates(tag):
+    """Build/load locations in preference order: next to the source (repo
+    checkout), per-user cache, tmpdir.  Writability is discovered by
+    ATTEMPTING the build, not os.access — root on a read-only filesystem
+    passes access(2) and then fails at write time."""
+    import tempfile
+
+    yield os.path.join(_HERE, f"_native-{tag}.so")
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "psrsigsim_tpu")
+    yield os.path.join(cache, f"_native-{tag}.so")
+    yield os.path.join(tempfile.gettempdir(), f"pss_native-{tag}.so")
+
+
+def _build(so_path):
     # compile to a temp name and rename: the publish is atomic, so a
     # concurrent process never dlopens a partially written library and a
     # rebuild never truncates an .so another process has mmapped
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+    os.makedirs(os.path.dirname(so_path), exist_ok=True)
+    tmp = f"{so_path}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so_path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -61,25 +89,30 @@ def _load():
         if os.environ.get("PSS_NO_NATIVE"):
             return None
         try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
-            if lib.pss_abi_version() != 1:
-                return None
-            lib.pss_encode_subints_i2be.argtypes = [
-                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
-            ]
-            lib.pss_encode_subints_i2be.restype = None
-            lib.pss_format_pdv_block.argtypes = [
-                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
-            ]
-            lib.pss_format_pdv_block.restype = ctypes.c_int64
-            _lib = lib
-        except Exception:
-            _lib = None
+            tag = _src_tag()
+        except OSError:
+            return None
+        for so in _so_candidates(tag):
+            try:
+                if not os.path.exists(so):
+                    _build(so)
+                lib = ctypes.CDLL(so)
+                if lib.pss_abi_version() != 1:
+                    continue
+                lib.pss_encode_subints_i2be.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+                ]
+                lib.pss_encode_subints_i2be.restype = None
+                lib.pss_format_pdv_block.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                ]
+                lib.pss_format_pdv_block.restype = ctypes.c_int64
+                _lib = lib
+                break
+            except Exception:
+                continue
         return _lib
 
 
@@ -95,20 +128,23 @@ def encode_available():
     """True when the native int16 encode is byte-identical to numpy's
     float32 -> '>i2' cast on this host.  Out-of-range and NaN conversion is
     ISA-dependent (x86 cvttss2si vs ARM saturating fcvtzs), so parity is
-    probed at load time rather than assumed."""
+    probed at load time rather than assumed.  The probe runs under the
+    loader lock so concurrent first calls compute it once (benign race
+    otherwise, but consistent with ``_load``'s locking)."""
     global _cast_ok
     if not available():
         return False
-    if _cast_ok is None:
-        probe = np.array(
-            [[3e9, -3e9, np.nan, 2.2e9, -2.2e9, 65000.0, -65000.0,
-              1.9, -1.9, 200.7, -200.7, 0.0]],
-            dtype=np.float32,
-        )
-        with np.errstate(invalid="ignore"):
-            expect = probe.astype(">i2")
-        got = encode_subints(probe, 1, probe.shape[1])[0, 0]
-        _cast_ok = bool(np.array_equal(got, expect))
+    with _lock:
+        if _cast_ok is None:
+            probe = np.array(
+                [[3e9, -3e9, np.nan, 2.2e9, -2.2e9, 65000.0, -65000.0,
+                  1.9, -1.9, 200.7, -200.7, 0.0]],
+                dtype=np.float32,
+            )
+            with np.errstate(invalid="ignore"):
+                expect = probe.astype(">i2")
+            got = encode_subints(probe, 1, probe.shape[1])[0, 0]
+            _cast_ok = bool(np.array_equal(got, expect))
     return _cast_ok
 
 
