@@ -12,10 +12,22 @@ whole ``(Nchan, Nsamp)`` block is one fused device sample.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-__all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm"]
+__all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm",
+           "SEQ_RNG_BLOCK", "blocked_chan_chi2", "blocked_chan_normal"]
+
+# Fixed span of global time samples per RNG key: ALL pipeline draws —
+# unsharded and sequence-sharded alike — are keyed by
+# (stage, channel, global block index), so the same seed produces the
+# same stream for any mesh shape, any shard count, and n=1 vs unsharded
+# (sample-for-sample; tests/test_seqshard.py).  Must not depend on the
+# mesh, or draws would change with the shard count.
+SEQ_RNG_BLOCK = 4096
 
 
 def chi2_sample(key, df, shape, dtype=jnp.float32):
@@ -26,6 +38,52 @@ def chi2_sample(key, df, shape, dtype=jnp.float32):
 def normal_sample(key, shape, dtype=jnp.float32):
     """Standard normal draws (amplitude-signal pulses and noise)."""
     return jax.random.normal(key, shape, dtype)
+
+
+def _blocked_chan_draw(sampler, key, chan_ids, t0, length, block, aligned):
+    """Per-channel draws for global time span ``[t0, t0+length)``, keyed by
+    ``(channel, global block index)``.
+
+    Each shard draws the whole RNG blocks covering its span and slices its
+    samples out, so the assembled stream is bit-identical for any sharding
+    of the time axis.  ``length`` and ``block`` are static; ``t0`` may be
+    traced.  ``aligned=True`` promises ``t0 % block == 0`` (statically
+    true for ``t0=0`` and for seq shards whose slab length divides by the
+    block), which drops the one-block overdraw and the dynamic slice.
+    """
+    if isinstance(t0, (int, np.integer)) and t0 % block == 0:
+        aligned = True
+    nblk = -(-length // block) + (0 if aligned else 1)
+    b0 = t0 // block
+
+    def per_chan(c):
+        ck = jax.random.fold_in(key, c)
+        blocks = jax.vmap(
+            lambda b: sampler(jax.random.fold_in(ck, b), (block,))
+        )(b0 + jnp.arange(nblk))
+        flat = blocks.reshape(-1)
+        if aligned:
+            return flat[:length]
+        return lax.dynamic_slice(flat, (t0 - b0 * block,), (length,))
+
+    return jax.vmap(per_chan)(chan_ids)
+
+
+def blocked_chan_chi2(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK,
+                      aligned=False):
+    """Blocked chi-squared draws (see :func:`_blocked_chan_draw`)."""
+    return _blocked_chan_draw(
+        lambda k, shape: chi2_sample(k, df, shape), key, chan_ids, t0,
+        length, block, aligned,
+    )
+
+
+def blocked_chan_normal(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK,
+                        aligned=False):
+    """Blocked standard-normal draws (see :func:`_blocked_chan_draw`)."""
+    return _blocked_chan_draw(
+        normal_sample, key, chan_ids, t0, length, block, aligned,
+    )
 
 
 def chi2_draw_norm(dtype, df):

@@ -35,7 +35,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.shift import coherent_dedisperse, fourier_shift
-from ..ops.stats import chi2_sample, normal_sample
+from ..ops.stats import (SEQ_RNG_BLOCK, blocked_chan_chi2,
+                         blocked_chan_normal)
 from ..simulate.pipeline import _dispersion_delays, _null_mask_row
 from ..utils.rng import stage_key
 
@@ -51,10 +52,6 @@ __all__ = ["SEQ_AXIS", "SEQ_RNG_BLOCK", "make_seq_mesh",
            "blocked_chan_chi2", "blocked_chan_normal"]
 
 SEQ_AXIS = "seq"
-
-# Fixed span of global time samples per RNG key. Must not depend on the
-# mesh, or draws would change with the shard count.
-SEQ_RNG_BLOCK = 16384
 
 
 def make_seq_mesh(n_devices=None, devices=None):
@@ -82,46 +79,6 @@ def make_seq_mesh(n_devices=None, devices=None):
     return Mesh(np.asarray(devices), (SEQ_AXIS,))
 
 
-def _blocked_chan_draw(sampler, key, chan_ids, t0, length, block):
-    """Per-channel draws for global time span ``[t0, t0+length)``, keyed by
-    ``(channel, global block index)``.
-
-    Each shard draws the whole RNG blocks covering its slab and slices its
-    span out, so the assembled stream is bit-identical for any sharding of
-    the time axis (the ≤1-block overdraw at each edge is the price).
-    ``length`` and ``block`` are static; ``t0`` may be traced.
-    """
-    nblk = (length + block - 1) // block + 1  # covers any t0 alignment
-    b0 = t0 // block
-
-    def per_chan(c):
-        ck = jax.random.fold_in(key, c)
-        blocks = jax.vmap(
-            lambda b: sampler(jax.random.fold_in(ck, b), (block,))
-        )(b0 + jnp.arange(nblk))
-        return lax.dynamic_slice(blocks.reshape(-1), (t0 - b0 * block,),
-                                 (length,))
-
-    return jax.vmap(per_chan)(chan_ids)
-
-
-def blocked_chan_chi2(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK):
-    """Blocked chi-squared draws (see :func:`_blocked_chan_draw`)."""
-    return _blocked_chan_draw(
-        lambda k, shape: chi2_sample(k, df, shape), key, chan_ids, t0,
-        length, block,
-    )
-
-
-def blocked_chan_normal(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK):
-    """Blocked standard-normal draws (see :func:`_blocked_chan_draw`)."""
-    return _blocked_chan_draw(
-        normal_sample, key, chan_ids, t0, length, block,
-    )
-
-
-
-
 def _search_seq_body(cfg, n, L):
     """The per-shard SEARCH body over a ``(Nchan, L)`` time slab: blocked
     synthesis + nulling, all_to_all transposes around the exact Fourier
@@ -129,6 +86,9 @@ def _search_seq_body(cfg, n, L):
     (obs × seq) ensemble; vmapping it batches the collectives."""
     nchan = cfg.meta.nchan
     freqs_full = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float32)
+    # t0 = shard * L: block-aligned for every shard when L divides by the
+    # RNG block, which drops the one-block overdraw per edge
+    aligned = (L % SEQ_RNG_BLOCK == 0)
 
     def body(key, dm, noise_norm, profiles, extra_delays_ms):
         # profiles (Nchan, nph) replicated; this shard owns global time
@@ -142,8 +102,8 @@ def _search_seq_body(cfg, n, L):
         # synthesis: portrait value at each global sample phase x chi2(1)
         idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
         block = jnp.take(profiles, idx, axis=1)
-        block = block * blocked_chan_chi2(kp, chan_ids, 1.0, t0, L) \
-            * cfg.draw_norm
+        block = block * blocked_chan_chi2(kp, chan_ids, 1.0, t0, L,
+                                          aligned=aligned) * cfg.draw_norm
 
         # nulling: shared global-index mask (one source of truth with
         # single_pipeline); same keys on every shard
@@ -154,7 +114,8 @@ def _search_seq_body(cfg, n, L):
             # (reference: pulsar.py:304), keyed by pseudo-channel id
             # ``nchan`` to stay clear of real channel streams
             repl_row = blocked_chan_chi2(
-                knz, jnp.asarray([nchan]), cfg.null_df, t0, L
+                knz, jnp.asarray([nchan]), cfg.null_df, t0, L,
+                aligned=aligned,
             )[0] * cfg.draw_norm * cfg.off_pulse_mean
             block = jnp.where(mask_row[None, :], repl_row[None, :], block)
 
@@ -169,7 +130,8 @@ def _search_seq_body(cfg, n, L):
         block = lax.all_to_all(gathered, SEQ_AXIS, 1, 0, tiled=True)
 
         # radiometer noise (chi2 df=1 in search mode), time-sharded
-        noise = blocked_chan_chi2(kn, chan_ids, cfg.noise_df, t0, L)
+        noise = blocked_chan_chi2(kn, chan_ids, cfg.noise_df, t0, L,
+                                  aligned=aligned)
         return block + noise * noise_norm
 
     return body
@@ -182,10 +144,10 @@ def seq_sharded_search(cfg, mesh=None):
     Semantics mirror :func:`~psrsigsim_tpu.simulate.single_pipeline`
     (synthesis → in-graph nulling → dispersion shift → radiometer noise;
     reference chain pulsar.py:222-333, ism.py:40-74, receiver.py:140-172)
-    with one difference: random draws are block-keyed (see
-    :func:`blocked_chan_chi2`) instead of one stream per channel, so the
-    two pipelines agree in distribution but not sample-for-sample.  Within
-    this pipeline, results are bit-identical for ANY sequence shard count
+    exactly: BOTH pipelines draw through the same
+    (stage, channel, global RNG block) keying (ops/stats.py), so the
+    sharded stream equals the unsharded one sample-for-sample and results
+    are bit-identical for ANY sequence shard count
     (tests/test_seqshard.py).
 
     Requires ``cfg.nsamp`` and ``cfg.meta.nchan`` divisible by the shard
@@ -280,18 +242,19 @@ def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
     overlap-save coherent dedispersion (:func:`seq_sharded_dedisperse`),
     and blocked amplitude radiometer noise (reference receiver.py:123-138).
 
-    Draw streams are block-keyed, so like :func:`seq_sharded_search` this
-    agrees with the unsharded
-    :func:`~psrsigsim_tpu.simulate.baseband_pipeline` in DISTRIBUTION, not
-    sample-for-sample.  Within this pipeline, draws are bit-identical for
-    any shard count, and the dedispersion stage matches the exact circular
-    filter on the same input up to the halo truncation
+    Draw streams use the same (stage, channel, global RNG block) keying as
+    the unsharded :func:`~psrsigsim_tpu.simulate.baseband_pipeline`, so
+    the synthesized and noise samples match it exactly; draws are
+    bit-identical for any shard count, and the dedispersion stage matches
+    the exact circular filter on the same input up to the halo truncation
     (tests/test_seqshard_baseband.py).  ``dm`` is static.
 
     Returns ``run(key, noise_norm, sqrt_profiles) -> (Npol, nsamp)``.
     """
     mesh, n, L = _seq_prologue(cfg, mesh)
     dedisp = _make_dedisp_local(cfg, dm, n, L, halo)
+
+    aligned = (L % SEQ_RNG_BLOCK == 0)
 
     def _local(key, noise_norm, sqrt_profiles):
         shard = lax.axis_index(SEQ_AXIS)
@@ -303,11 +266,12 @@ def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
 
         idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
         amp = jnp.take(sqrt_profiles, idx, axis=1)
-        block = amp * blocked_chan_normal(kp, chan_ids, t0, L)
+        block = amp * blocked_chan_normal(kp, chan_ids, t0, L,
+                                          aligned=aligned)
 
         block = dedisp(block)
 
-        noise = blocked_chan_normal(kn, chan_ids, t0, L)
+        noise = blocked_chan_normal(kn, chan_ids, t0, L, aligned=aligned)
         return block + noise * noise_norm
 
     return jax.jit(

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.shift import coherent_dedisperse, fourier_shift
-from ..ops.stats import chi2_sample, normal_sample
+from ..ops.stats import blocked_chan_chi2, blocked_chan_normal
 from ..signal.state import SignalMeta
 from ..utils.constants import DM_K_MS_MHZ2
 from ..utils.rng import stage_key
@@ -69,18 +69,17 @@ def _freqs_mhz(cfg):
 
 
 def _chan_chi2(key, chan_ids, df, nsamp):
-    """Per-channel chi2 draws keyed by GLOBAL channel id: results are
-    bit-identical for any mesh shape or channel-shard split."""
-    return jax.vmap(
-        lambda c: chi2_sample(jax.random.fold_in(key, c), df, (nsamp,))
-    )(chan_ids)
+    """Per-channel chi2 draws keyed by (GLOBAL channel id, GLOBAL RNG
+    block): ONE keying scheme for every pipeline — results are
+    bit-identical for any mesh shape, channel-shard split, or sequence
+    shard count, and the seq-sharded pipelines reproduce these exact
+    streams (ops/stats.py blocked draws)."""
+    return blocked_chan_chi2(key, chan_ids, df, 0, nsamp)
 
 
 def _chan_normal(key, chan_ids, nsamp):
-    """Per-channel N(0,1) draws keyed by GLOBAL channel id."""
-    return jax.vmap(
-        lambda c: normal_sample(jax.random.fold_in(key, c), (nsamp,))
-    )(chan_ids)
+    """Per-channel N(0,1) draws, block-keyed like :func:`_chan_chi2`."""
+    return blocked_chan_normal(key, chan_ids, 0, nsamp)
 
 
 def _dispersion_delays(dm, freqs, extra_delays_ms):
@@ -368,11 +367,12 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
     if cfg.n_null > 0:
         knz = stage_key(key, "null_noise")
         mask_row = _null_mask_row(key, cfg, 0, nsamp)
-        repl_row = (
-            chi2_sample(knz, cfg.null_df, (nsamp,))
-            * cfg.draw_norm
-            * cfg.off_pulse_mean
-        )
+        # one replacement-noise row broadcast to all channels (reference:
+        # pulsar.py:304), keyed by pseudo-channel id ``nchan`` — the same
+        # stream the seq-sharded pipeline draws
+        repl_row = blocked_chan_chi2(
+            knz, jnp.asarray([cfg.meta.nchan]), cfg.null_df, 0, nsamp
+        )[0] * cfg.draw_norm * cfg.off_pulse_mean
         block = jnp.where(mask_row[None, :], repl_row[None, :], block)
 
     # dispersion (+ FD/scatter) as ONE batched shift

@@ -246,9 +246,12 @@ class TestFunctionalPipeline:
         shift_bins = _circular_shift(prof7, prof0, cfg.nph)
         expect_ms = DM_K_MS_MHZ2 * 10.0 * (freqs[0] ** -2 - freqs[7] ** -2)
         expect_bins = int(round(expect_ms / cfg.dt_ms)) % cfg.nph
-        # chi2 draw noise on a wide pulse: allow a few bins of slop
+        # chi2 draw noise jitters the correlation peak of a wide pulse by
+        # O(width/sqrt(nsub)) bins; 0.1% of a period of slop keeps the
+        # check meaningful without depending on the draw stream
+        tol = max(5, cfg.nph // 1000)
         assert min(abs(shift_bins - expect_bins),
-                   cfg.nph - abs(shift_bins - expect_bins)) <= 5
+                   cfg.nph - abs(shift_bins - expect_bins)) <= tol
 
 
 class TestEnsembleSharded:
@@ -328,7 +331,10 @@ class TestEnsembleSharded:
         prof_hi0 = data[0, 3].reshape(ens.cfg.nsub, nph).mean(0)
         prof_lo0 = data[0, 0].reshape(ens.cfg.nsub, nph).mean(0)
         shift_dm0 = _circular_shift(prof_hi0, prof_lo0, nph)
-        assert min(shift_dm0, nph - shift_dm0) <= 2
+        # dm=0 channels align up to the draw-noise jitter of the
+        # correlation peak (0.1% of a period; see the matching tolerance
+        # in test_pipeline_dispersion_matches_delays)
+        assert min(shift_dm0, nph - shift_dm0) <= max(2, nph // 1000)
 
     def test_folded_profiles_shape(self):
         d = dict(SIMDICT)
