@@ -195,12 +195,14 @@ class PSRFITS(BaseFile):
         hist[0]["CHAN_BW"] = subint_dict["CHAN_BW"]
         hist[0]["DM"] = subint_dict["DM"]
 
-        self.set_draft_header(
-            "SUBINT",
-            {"EPOCHS": subint_dict["EPOCHS"], "CHAN_BW": subint_dict["CHAN_BW"],
-             "POL_TYPE": subint_dict["POL_TYPE"], "TBIN": subint_dict["TBIN"],
-             "DM": subint_dict["DM"], "NBIN": subint_dict["NBIN"]},
-        )
+        subint_hdr = {
+            "EPOCHS": subint_dict["EPOCHS"], "CHAN_BW": subint_dict["CHAN_BW"],
+            "POL_TYPE": subint_dict["POL_TYPE"], "TBIN": subint_dict["TBIN"],
+            "DM": subint_dict["DM"], "NBIN": subint_dict["NBIN"],
+        }
+        if "NSTOT" in subint_dict:
+            subint_hdr["NSTOT"] = subint_dict["NSTOT"]
+        self.set_draft_header("SUBINT", subint_hdr)
         for ii in range(len(subint_dict["OFFS_SUB"])):
             self.HDU_drafts["SUBINT"][ii]["OFFS_SUB"] = subint_dict["OFFS_SUB"][ii]
             self.HDU_drafts["SUBINT"][ii]["TSUBINT"] = subint_dict["TSUBINT"][ii]
@@ -258,9 +260,13 @@ class PSRFITS(BaseFile):
             else:
                 out = q_data.astype(">i2")[:, None, :, :]
         elif search:
-            # (Nchan, nsamp) -> per-row (nsblk, npol, nchan) time-major
-            stop = row_len * self.nsubint
-            sim_sig = np.asarray(signal.data)[:, :stop].astype(">i2")
+            # (Nchan, nsamp) -> per-row (nsblk, npol, nchan) time-major;
+            # a final short row is zero-padded to NSBLK samples
+            total = row_len * self.nsubint
+            sim_sig = np.asarray(signal.data)[:, :total].astype(">i2")
+            if sim_sig.shape[1] < total:
+                sim_sig = np.pad(sim_sig,
+                                 ((0, 0), (0, total - sim_sig.shape[1])))
             out = (
                 sim_sig.reshape(self.nchan, self.nsubint, row_len)
                 .transpose(1, 2, 0)[:, :, None, :]
@@ -338,6 +344,10 @@ class PSRFITS(BaseFile):
         subint_dict["DM"] = (signal.dm.value if signal.dm is not None
                              else 0.0)
         subint_dict["NBIN"] = self.nbin
+        if search:
+            # true sample count: the final SEARCH row may be zero-padded
+            # to NSBLK, and load() must trim the padding back off
+            subint_dict["NSTOT"] = int(signal.nsamp)
         self._edit_psrfits_header(polyco_dict, subint_dict, primary_dict)
 
         self.write_psrfits(hdr_from_draft=True)
@@ -405,6 +415,12 @@ class PSRFITS(BaseFile):
             # (rows, nsblk, npol, nchan) -> (nchan, rows*nsblk)
             phys = raw[:, :, 0, :] * scl[:, None, :] + offs[:, None, :]
             data = phys.transpose(2, 0, 1).reshape(nchan, -1)
+            # trim the zero-padding of a short final row (NSTOT records
+            # the true sample count; absent in pre-round-3 files, whose
+            # rows always tiled exactly)
+            nstot = hdr.get("NSTOT")
+            if nstot:
+                data = data[:, : int(nstot)]
         else:
             # (rows, npol, nchan, nbin) -> (nchan, rows*nbin)
             phys = raw[:, 0, :, :] * scl[:, :, None] + offs[:, :, None]
@@ -584,10 +600,12 @@ class PSRFITS(BaseFile):
             self.nbin = 1
             self.npol = signal.Npols
             nsamp = int(signal.nsamp)
-            # largest row length <= 4096 that tiles the stream exactly
-            self.nsblk = max(k for k in range(1, min(4096, nsamp) + 1)
-                             if nsamp % k == 0)
-            self.nrows = nsamp // self.nsblk
+            # fixed row length; the final short row (if any) is written
+            # zero-padded.  The previous exact-divisor rule degenerated to
+            # NSBLK=1 for prime/awkward nsamp — one SUBINT row per sample
+            # with full DAT_* arrays each (ADVICE r2): pathological files.
+            self.nsblk = min(4096, nsamp)
+            self.nrows = -(-nsamp // self.nsblk)
             self.obsfreq = signal.fcent
             self.obsbw = signal.bw
             self.chan_bw = signal.bw / signal.Nchan

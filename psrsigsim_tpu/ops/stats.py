@@ -51,8 +51,10 @@ def _blocked_chan_draw(sampler, key, chan_ids, t0, length, block, aligned):
     true for ``t0=0`` and for seq shards whose slab length divides by the
     block), which drops the one-block overdraw and the dynamic slice.
     """
-    if isinstance(t0, (int, np.integer)) and t0 % block == 0:
-        aligned = True
+    if isinstance(t0, (int, np.integer)):
+        # static t0: compute alignment instead of trusting the caller —
+        # a wrong promise would silently return samples from b0*block
+        aligned = (t0 % block == 0)
     nblk = -(-length // block) + (0 if aligned else 1)
     b0 = t0 // block
 

@@ -117,3 +117,48 @@ def _data_col(sub_hdu):
         if k.startswith("TTYPE") and str(v).strip() == "DATA":
             return int(k[5:])
     raise AssertionError("no DATA column")
+
+
+class TestAwkwardRowLength:
+    def test_prime_nsamp_pads_final_row(self, tmp_path):
+        # ADVICE r2: an exact-divisor NSBLK rule degenerated to NSBLK=1
+        # for prime nsamp (one SUBINT row per sample); now the row length
+        # is fixed and the final short row is zero-padded
+        sig = FilterBankSignal(1400.0, 400.0, Nsubband=2,
+                               sample_rate=0.2048, fold=False)
+        psr = Pulsar(0.005, 0.05, GaussProfile(width=0.02), name="P",
+                     seed=1)
+        psr.make_pulses(sig, tobs=0.1)
+        nsamp_prime = 20479  # prime-ish awkward length
+        sig.data = np.asarray(sig.data)[:, :nsamp_prime]
+        sig._nsamp = nsamp_prime
+        ISM().disperse(sig, 12.0)
+
+        out = str(tmp_path / "prime.fits")
+        pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="SEARCH")
+        pfit.get_signal_params(signal=sig)
+        assert pfit.nsblk == 4096            # fixed, not 1
+        assert pfit.nrows == 5               # ceil(20479/4096)
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            pfit.save(sig, psr, verbose=False)
+        finally:
+            os.chdir(cwd)
+
+        f = FitsFile.read(out)
+        sub = f["SUBINT"]
+        assert len(sub.data) == 5
+        # last row: first (20479 - 4*4096) = 4095 samples real, last padded
+        last = sub.data["DATA"][4]           # (nsblk, npol, nchan)
+        expect = np.asarray(sig.data)[:, 4 * 4096:].astype(">i2")
+        np.testing.assert_array_equal(last[:4095, 0, :].T, expect)
+        np.testing.assert_array_equal(last[4095:, 0, :], 0)
+
+        # NSTOT records the true length, so load() trims the padding and
+        # the round-trip keeps the exact sample count
+        back = PSRFITS(path=out, template=out, obs_mode="SEARCH").load()
+        got = np.asarray(back.data)
+        assert got.shape == (2, nsamp_prime)
+        np.testing.assert_array_equal(
+            got.astype(">i2"), np.asarray(sig.data).astype(">i2"))

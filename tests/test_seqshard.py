@@ -109,7 +109,11 @@ class TestSeqShardedSearch:
         return int(np.argmax(np.fft.irfft(r * np.conj(t), n=len(row))))
 
     @needs8
-    def test_statistics_match_unsharded_pipeline(self):
+    def test_matches_unsharded_pipeline(self):
+        # since the round-3 RNG unification the sharded pipeline draws the
+        # SAME streams as single_pipeline; at n=8 the only residual is
+        # FFT-plan rounding through the all_to_all dispersion stage (see
+        # TestUnifiedRNG for the exact n=1 and tolerance rationale)
         cfg, profiles, nn = _search_cfg()
         key = jax.random.key(7)
         sharded = np.asarray(
@@ -118,23 +122,9 @@ class TestSeqShardedSearch:
         plain = np.asarray(
             single_pipeline(key, 15.0, nn, profiles, cfg)
         )
-        # different RNG block structure -> compare moments and pulse shape
-        assert np.allclose(sharded.mean(), plain.mean(), rtol=0.03)
-        assert np.allclose(sharded.std(), plain.std(), rtol=0.05)
-        # dispersed pulse lands at the same phase, channel by channel
-        # (noise-free reruns; the chi2 pulse draws still differ)
-        sh0 = np.asarray(
-            seq_sharded_search(cfg, make_seq_mesh(8))(key, 15.0, 0.0, profiles)
-        )
-        pl0 = np.asarray(single_pipeline(key, 15.0, 0.0, profiles, cfg))
-        nsub, nph = cfg.nsub, cfg.nph
-        f_sh = sh0[:, : nsub * nph].reshape(-1, nsub, nph).mean(axis=1)
-        f_pl = pl0[:, : nsub * nph].reshape(-1, nsub, nph).mean(axis=1)
-        prof = np.asarray(profiles)
-        for c in range(cfg.meta.nchan):
-            a = self._xcorr_shift(f_sh[c], prof[c])
-            b = self._xcorr_shift(f_pl[c], prof[c])
-            assert min((a - b) % nph, (b - a) % nph) <= 2
+        l2 = np.sqrt(np.mean(plain.astype(np.float64) ** 2)
+                     * plain.shape[-1])
+        assert np.max(np.abs(sharded - plain)) < 1e-5 * l2
 
     @needs8
     def test_nulling_in_graph(self):
