@@ -419,7 +419,7 @@ class PSRFITS(BaseFile):
             # the true sample count; absent in pre-round-3 files, whose
             # rows always tiled exactly)
             nstot = hdr.get("NSTOT")
-            if nstot:
+            if nstot is not None:
                 data = data[:, : int(nstot)]
         else:
             # (rows, npol, nchan, nbin) -> (nchan, rows*nbin)

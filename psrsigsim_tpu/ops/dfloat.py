@@ -1,0 +1,122 @@
+"""Double-float (two-float32) arithmetic for in-graph phase accumulation.
+
+Dispersion phases reach 1e5-1e7 cycles; float32 resolves ~2^-24 of the
+VALUE, so building such a phase in f32 and reducing mod 1 keeps errors of
+``phase * 2^-24`` — up to whole radians.  The concrete-`dm` paths avoid
+this by building phases in float64 on host (ops/shift.py), but in-graph
+DM ensembles trace `dm`, and TPU graphs have no float64.  DIVERGENCES #4
+documented the resulting ~1e-2 rad error; this module closes it.
+
+The classical error-free transformations (Dekker 1971 / Knuth) emulate a
+~48-bit mantissa with (hi, lo) float32 pairs:
+
+- ``two_sum`` / ``two_prod``: exact sum/product as value + rounding error
+  (``two_prod`` via Veltkamp splitting — no FMA required, and XLA does
+  not reassociate float arithmetic, so the transformations hold on TPU).
+- ``df_mul_f32``: (f32 exact input) x (hi, lo) -> (hi, lo).
+- ``df_recip``: two-float reciprocal via one Newton correction.
+- ``df_mod1``: fractional part of a (hi, lo) value as plain f32 — the
+  final phase only needs f32 ABSOLUTE accuracy once the huge integer
+  part is removed exactly.
+
+Used by :func:`psrsigsim_tpu.ops.shift.fourier_shift` (traced shifts) and
+:func:`~psrsigsim_tpu.ops.shift.coherent_dedispersion_transfer` (traced
+dm): the static per-bin coefficients are computed in float64 on host,
+split into (hi, lo) f32 planes, and the traced multiply + mod-1 runs in
+double-float on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["split_f64", "two_sum", "two_prod", "df_mul_f32", "df_recip",
+           "df_mod1", "df_div_f32"]
+
+
+def _rounded(x):
+    """Pin an intermediate to its IEEE-rounded value.
+
+    XLA's algebraic simplifier rewrites patterns like ``(a + b) - a -> b``
+    in fused graphs — mathematically true, floating-point false, and
+    fatal to error-free transformations (observed: the compensation term
+    of a fused two_sum silently became 0).  An optimization barrier makes
+    the rounded sum opaque to such rewrites."""
+    return lax.optimization_barrier(x)
+
+# Veltkamp splitter for float32 (24-bit mantissa): 2^12 + 1.  A plain
+# Python float: a module-level jnp constant would capture the mesh
+# context of its first use and break under other shard_map meshes.
+_SPLITTER = 4097.0
+
+
+def split_f64(values):
+    """Host-side: split float64 array into (hi, lo) float32 planes with
+    hi + lo == value to ~2^-48 relative."""
+    import numpy as np
+
+    v = np.asarray(values, np.float64)
+    hi = v.astype(np.float32)
+    lo = (v - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def _veltkamp(a):
+    c = _rounded(_SPLITTER * a)
+    hi = _rounded(c - _rounded(c - a))
+    return hi, a - hi
+
+
+def two_sum(a, b):
+    """s + e == a + b exactly (Knuth)."""
+    s = _rounded(a + b)
+    bb = s - a
+    return s, (a - (s - bb)) + (b - bb)
+
+
+def _quick_two_sum(a, b):
+    """two_sum assuming |a| >= |b|."""
+    s = _rounded(a + b)
+    return s, b - (s - a)
+
+
+def two_prod(a, b):
+    """p + e == a * b exactly (Dekker, via Veltkamp splitting)."""
+    p = _rounded(a * b)
+    ah, al = _veltkamp(a)
+    bh, bl = _veltkamp(b)
+    return p, ((ah * bh - p) + ah * bl + al * bh) + al * bl
+
+
+def df_mul_f32(a, bhi, blo):
+    """(hi, lo) product of an exact f32 ``a`` with a double-float b."""
+    p, e = two_prod(a, bhi)
+    return _quick_two_sum(p, e + a * blo)
+
+
+def df_recip(b):
+    """Double-float reciprocal of an f32 ``b`` (one Newton step)."""
+    r = 1.0 / b
+    p, e = two_prod(r, b)
+    # 1 - r*b to double precision, times r
+    return _quick_two_sum(r, ((1.0 - p) - e) * r)
+
+
+def df_div_f32(a, b):
+    """a / b as a double-float, for exact f32 inputs."""
+    rhi, rlo = df_recip(b)
+    return df_mul_f32(a, rhi, rlo)
+
+
+def df_mod1(hi, lo):
+    """Fractional part of hi + lo in [0, 1) as plain float32.
+
+    ``hi - floor(hi)`` is exact (Sterbenz); adding ``lo`` and re-wrapping
+    leaves only the final f32 rounding (~2^-24 absolute) — which is all a
+    phase needs once the integer cycles are gone."""
+    frac = hi - jnp.floor(hi)
+    s, e = two_sum(frac, lo)
+    s = s - jnp.floor(s)
+    out = s + e
+    return out - jnp.floor(out)

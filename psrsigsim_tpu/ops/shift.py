@@ -61,11 +61,12 @@ def fourier_shift(data, shifts, dt=1.0):
     (the standard path) the ramp is built in float64 on host, reduced mod 1
     cycle, and shipped as a complex64 constant — bit-comparable to the
     reference's float64 ``shift_t``.  When traced (in-graph delay
-    ensembles), the shift is wrapped mod the circular period ``n*dt`` before
-    building the ramp, so the phase error is bounded by
-    ``max(shift/dt, n/2) * eps_f32`` cycles — the first term is the
-    irreducible quantization of a float32 shift itself.  Keep ``shift/dt``
-    modest (or pass concrete shifts) for sub-percent accuracy.
+    ensembles), the ramp accumulates in double-float32 (ops/dfloat.py):
+    the ``k * shift/period`` products carry ~48 mantissa bits before the
+    mod-1 reduction, leaving ~1e-6-cycle ramp error; what remains is the
+    float32 representation of the traced shift itself
+    (``~eps_f32 * shift/dt / 2`` cycles at Nyquist), irreducible without
+    double-float delays upstream.
     """
     import numpy as np
 
@@ -84,15 +85,31 @@ def fourier_shift(data, shifts, dt=1.0):
         phase = jax.lax.complex(jnp.asarray(re), jnp.asarray(im)).astype(spec.dtype)
         return jnp.fft.irfft(spec * phase, n=n, axis=-1)
 
-    # traced path: wrap the (circular) shift into one period so the phase
-    # magnitude — and with it the float32 error, ~(n/2)·eps cycles — is
-    # bounded by the transform length instead of the raw delay
+    # traced path: double-float ramp accumulation (ops/dfloat.py).  The
+    # shift/period ratio and the k*ratio products carry ~48 mantissa bits,
+    # so the old mod-wrap error of ~(n/2)*eps_f32 cycles is gone; what
+    # remains is the f32 representation of the traced shift itself
+    # (~eps_f32 * shift/dt / 2 cycles at Nyquist) — irreducible without
+    # double-float delays upstream (DIVERGENCES #4).
+    from .dfloat import df_mod1, df_mul_f32, df_recip, split_f64
+
     spec = jnp.fft.rfft(data, axis=-1)
-    period = n * dt
-    frac = jnp.mod(jnp.asarray(shifts), period)[..., None] / period  # in [0, 1)
-    k = jnp.arange(n // 2 + 1, dtype=spec.real.dtype)
-    cycles = jnp.mod(k[None, :] * frac, 1.0)
-    phase = jnp.exp((-2j * jnp.pi) * cycles)
+    if _is_concrete(dt):
+        # static sample spacing: the reciprocal period in host float64,
+        # shipped as an exact (hi, lo) pair
+        rh, rl = split_f64(1.0 / (n * float(dt)))
+        rhi, rlo = jnp.float32(rh), jnp.float32(rl)
+    else:
+        # traced dt (hetero per-pulsar spacing): f32 dt is the input's
+        # own precision; the reciprocal adds nothing beyond it
+        period = jnp.float32(n) * jnp.asarray(dt, jnp.float32)
+        rhi, rlo = df_recip(period)
+    shifts32 = jnp.asarray(shifts, jnp.float32)[..., None]
+    ratio_hi, ratio_lo = df_mul_f32(shifts32, rhi, rlo)
+    k = jnp.arange(n // 2 + 1, dtype=jnp.float32)  # exact: n//2 < 2^24
+    chi, clo = df_mul_f32(k[None, :], ratio_hi, ratio_lo)
+    cycles = df_mod1(chi, clo)
+    phase = jnp.exp((-2j * jnp.pi) * cycles).astype(spec.dtype)
     return jnp.fft.irfft(spec * phase, n=n, axis=-1)
 
 
@@ -109,11 +126,15 @@ def coherent_dedispersion_transfer(nsamp, dm, fcent_mhz, bw_mhz, dt_us):
     :func:`_apply_spectral_filter`).
 
     Dispersion phases reach ~1e5-1e7 radians, far beyond float32's absolute
-    phase resolution, so when ``dm`` is a concrete scalar (the normal API
-    path) the phase is built in float64 on host, reduced mod 2π, and shipped
-    to device as a complex64 constant.  A traced ``dm`` (in-graph DM
-    ensembles) falls back to float32 with ~1e-2 phase error — fine for
-    statistics, documented for parity.
+    phase resolution.  When ``dm`` is a concrete scalar (the normal API
+    path) the phase is built in float64 on host, reduced mod 2π, and
+    shipped to device as a complex64 constant.  A traced ``dm`` (in-graph
+    DM ensembles) multiplies HOST-float64 per-bin cycle coefficients —
+    split into (hi, lo) float32 planes — by ``dm`` in double-float
+    arithmetic (ops/dfloat.py) and reduces mod 1 before the trig, leaving
+    ~1e-5-cycle phase error instead of the former ~1e-2 rad (closes
+    DIVERGENCES #4 for the coherent path; the band geometry is static, so
+    only the dm multiply runs traced).
     """
     import numpy as np
 
@@ -127,6 +148,21 @@ def coherent_dedispersion_transfer(nsamp, dm, fcent_mhz, bw_mhz, dt_us):
         # real/imag float planes: complex arrays can't cross the host<->device
         # boundary on all backends (see _apply_spectral_filter)
         return np.cos(phase).astype(np.float32), np.sin(phase).astype(np.float32)
+
+    if _is_concrete(dt_us) and _is_concrete(fcent_mhz) and _is_concrete(bw_mhz):
+        from .dfloat import df_mod1, df_mul_f32, split_f64
+
+        # cycles(f) = dm * c(f): c static -> float64 on host, (hi, lo) split
+        f = np.fft.rfftfreq(nsamp, d=float(dt_us)) - bw_mhz / 2.0
+        c = 1.0e6 * dm_k_s * f**2 / ((f + fcent_mhz) * fcent_mhz**2)
+        c_hi, c_lo = split_f64(c)
+        chi, clo = df_mul_f32(jnp.asarray(dm, jnp.float32),
+                              jnp.asarray(c_hi), jnp.asarray(c_lo))
+        phase = (2.0 * jnp.pi) * df_mod1(chi, clo)
+        return jnp.cos(phase), jnp.sin(phase)
+
+    # fully-traced band geometry (rare): plain float32, the pre-round-3
+    # accuracy (~1e-2 rad for MSP-scale phases)
     u = jnp.fft.rfftfreq(nsamp, d=dt_us)  # cycles/us == MHz
     f = u - bw_mhz / 2.0
     phase = 2.0e6 * jnp.pi * dm_k_s * dm * f**2 / ((f + fcent_mhz) * fcent_mhz**2)

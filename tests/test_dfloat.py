@@ -1,0 +1,114 @@
+"""Double-float (two-float32) phase accumulation (ops/dfloat.py) and its
+use in the traced-dm/dt paths of ops/shift.py — closing DIVERGENCES #4
+(in-graph DM ensembles previously carried ~1e-2 rad of float32 phase
+error; the concrete paths always built phases in host float64)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psrsigsim_tpu.ops.dfloat import (
+    df_div_f32,
+    df_mod1,
+    df_mul_f32,
+    split_f64,
+    two_prod,
+    two_sum,
+)
+from psrsigsim_tpu.ops.shift import (
+    coherent_dedispersion_transfer,
+    fourier_shift,
+)
+
+
+class TestPrimitives:
+    def test_two_sum_exact(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(scale=1e4, size=256).astype(np.float32)
+        b = rng.normal(scale=1e-3, size=256).astype(np.float32)
+        s, e = jax.jit(two_sum)(jnp.asarray(a), jnp.asarray(b))
+        lhs = np.asarray(s, np.float64) + np.asarray(e, np.float64)
+        rhs = a.astype(np.float64) + b.astype(np.float64)
+        np.testing.assert_array_equal(lhs, rhs)
+
+    def test_two_prod_exact(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(scale=1e3, size=256).astype(np.float32)
+        b = rng.normal(scale=1e2, size=256).astype(np.float32)
+        p, e = jax.jit(two_prod)(jnp.asarray(a), jnp.asarray(b))
+        lhs = np.asarray(p, np.float64) + np.asarray(e, np.float64)
+        rhs = a.astype(np.float64) * b.astype(np.float64)
+        np.testing.assert_array_equal(lhs, rhs)
+
+    def test_eft_survives_fusion(self):
+        # the regression that motivated the optimization barriers: inside
+        # a larger fused graph, XLA's (a+b)-a -> b rewrite used to zero
+        # the compensation terms while the standalone op stayed correct
+        a = jnp.float32(15.917)
+        bhi = jnp.float32(2506.748)
+        blo = jnp.float32(1.1429e-4)
+
+        @jax.jit
+        def fused(a, bhi, blo):
+            hi, lo = df_mul_f32(a, bhi, blo)
+            return df_mod1(hi, lo)
+
+        got = float(fused(a, bhi, blo))
+        exact = float(np.mod(
+            np.float64(np.float32(15.917))
+            * (np.float64(np.float32(2506.748))
+               + np.float64(np.float32(1.1429e-4))), 1.0))
+        assert abs(got - exact) < 1e-6
+
+    def test_df_div(self):
+        hi, lo = jax.jit(df_div_f32)(jnp.float32(1.0), jnp.float32(3.0))
+        val = np.float64(np.asarray(hi)) + np.float64(np.asarray(lo))
+        assert abs(val - 1.0 / 3.0) < 1e-14
+
+    def test_split_f64_roundtrip(self):
+        v = np.array([1e7 + 0.123456789, -3.14159e-4, 0.0])
+        hi, lo = split_f64(v)
+        np.testing.assert_allclose(hi.astype(np.float64) + lo, v,
+                                   rtol=1e-13)
+
+
+class TestTracedPhasePaths:
+    def test_coherent_traced_matches_host_f64(self):
+        # dm value chosen f32-exact so the comparison isolates the
+        # in-graph accumulation
+        nsamp, fc, bw, dt = 262144, 1400.0, 100.0, 0.005
+        dm = float(np.float32(15.917))
+        re_c, im_c = coherent_dedispersion_transfer(nsamp, dm, fc, bw, dt)
+        f = jax.jit(
+            lambda d: coherent_dedispersion_transfer(nsamp, d, fc, bw, dt))
+        re_t, im_t = f(jnp.float32(dm))
+        ang = np.angle((np.asarray(re_c) + 1j * np.asarray(im_c))
+                       * (np.asarray(re_t) - 1j * np.asarray(im_t)))
+        assert np.abs(ang).max() < 1e-5  # was ~1e-2+ rad in float32
+
+    def test_fourier_shift_traced_matches_host_f64(self):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(4, 65536)).astype(np.float32)
+        shifts = np.asarray(
+            np.array([55.0, 17.3, 3.14, 260.0], np.float32), np.float64)
+        dtms = 2.44e-3  # shift/dt up to ~1e5: f32 ramps lose ~1e-2 here
+        ref = np.asarray(fourier_shift(data, shifts, dt=dtms))
+        g = jax.jit(
+            lambda s: fourier_shift(jnp.asarray(data), s, dt=dtms))
+        got = np.asarray(g(jnp.asarray(shifts, jnp.float32)))
+        assert np.abs(got - ref).max() < 1e-4  # FFT rounding level
+
+    def test_fourier_shift_traced_dt(self):
+        # hetero path: dt traced too; the shift must still land within
+        # f32-of-the-inputs of the host-f64 reference
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=(2, 8192)).astype(np.float32)
+        shifts = np.array([3.25, 0.5], np.float32)
+        dtv = np.float32(0.001)
+        ref = np.asarray(fourier_shift(data, shifts.astype(np.float64),
+                                       dt=float(dtv)))
+        g = jax.jit(lambda s, d: fourier_shift(jnp.asarray(data), s, dt=d))
+        got = np.asarray(g(jnp.asarray(shifts), dtv))
+        assert np.abs(got - ref).max() < 1e-4
