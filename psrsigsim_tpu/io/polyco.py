@@ -30,8 +30,9 @@ import numpy as np
 from .timing import (TimingModel, UnsupportedTimingModelError,
                      check_model_supported, parse_par_full)
 
-__all__ = ["parse_par", "generate_polyco", "polyco_phase",
-           "UnsupportedTimingModelError", "check_par_supported"]
+__all__ = ["parse_par", "generate_polyco", "generate_polycos",
+           "polyco_phase", "UnsupportedTimingModelError",
+           "check_par_supported"]
 
 # (par fingerprint, fit args) -> polyco dict; see generate_polyco
 _POLYCO_CACHE = {}
@@ -168,6 +169,24 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
             _POLYCO_CACHE.clear()
         _POLYCO_CACHE[cache_key] = {**result, "COEFF": coeffs.copy()}
     return result
+
+
+def generate_polycos(parfile, MJD_start, duration_min, segLength=60.0,
+                     **kwargs):
+    """Polyco segments covering ``duration_min`` minutes from
+    ``MJD_start``: one TEMPO-form fit per ``segLength``-minute span
+    (ceil-covered, so the last segment may extend past the end).
+
+    Observations longer than one span need a POLYCO table, not a single
+    row — the folding software picks the matching segment by date.
+    Returns a list of dicts as :func:`generate_polyco`.
+    """
+    n = max(1, int(np.ceil(float(duration_min) / float(segLength))))
+    return [
+        generate_polyco(parfile, MJD_start + i * segLength / 1440.0,
+                        segLength=segLength, **kwargs)
+        for i in range(n)
+    ]
 
 
 def polyco_phase(polyco, mjd):

@@ -23,7 +23,7 @@ from ..utils.utils import make_par
 from . import native
 from .file import BaseFile
 from .fits import Card, FitsFile, Header, bintable_dtype
-from .polyco import generate_polyco
+from .polyco import generate_polyco, generate_polycos
 
 __all__ = ["PSRFITS"]
 
@@ -107,7 +107,7 @@ class PSRFITS(BaseFile):
     # -- polyco + metadata --------------------------------------------------
     def _gen_polyco(self, parfile, MJD_start, segLength=60.0, ncoeff=15,
                     maxha=12.0, method="TEMPO", numNodes=20, usePINT=True,
-                    strict=True, obs_freq=None):
+                    strict=True, obs_freq=None, duration_min=None):
         """Polyco parameters for the POLYCO HDU.
 
         Signature mirrors the reference (io/psrfits.py:116-143); generation
@@ -117,11 +117,17 @@ class PSRFITS(BaseFile):
         ``usePINT=False`` raises, as upstream.  ``strict=False`` skips the
         unsupported-timing-model gate.  ``obs_freq`` (MHz) computes the
         polyco at the observing frequency instead of the par's TZRFRQ.
+        With ``duration_min`` a LIST of per-segment dicts covering the
+        span is returned (one fit per segLength minutes).
         """
         if not usePINT:
             raise NotImplementedError(
                 "Only the PINT-equivalent path is supported for polycos"
             )
+        if duration_min is not None:
+            return generate_polycos(parfile, MJD_start, duration_min,
+                                    segLength=segLength, ncoeff=ncoeff,
+                                    strict=strict, obs_freq=obs_freq)
         return generate_polyco(parfile, MJD_start, segLength=segLength,
                                ncoeff=ncoeff, strict=strict,
                                obs_freq=obs_freq)
@@ -207,9 +213,17 @@ class PSRFITS(BaseFile):
             self.HDU_drafts["SUBINT"][ii]["OFFS_SUB"] = subint_dict["OFFS_SUB"][ii]
             self.HDU_drafts["SUBINT"][ii]["TSUBINT"] = subint_dict["TSUBINT"][ii]
 
-        for ky, val in polyco_dict.items():
-            if ky in self.HDU_drafts["POLYCO"].dtype.names:
-                self.HDU_drafts["POLYCO"][0][ky] = val
+        polyco_dicts = (polyco_dict if isinstance(polyco_dict, list)
+                        else [polyco_dict])
+        pol = self.HDU_drafts["POLYCO"]
+        if len(pol) != len(polyco_dicts):
+            # template POLYCO tables carry one row; tile it per segment
+            pol = np.repeat(pol[:1], len(polyco_dicts))
+            self.HDU_drafts["POLYCO"] = pol
+        for ii, pd in enumerate(polyco_dicts):
+            for ky, val in pd.items():
+                if ky in pol.dtype.names:
+                    pol[ii][ky] = val
 
         # prune binary-system parameters from PSRPARAM
         delete_params = ["BINARY", "A1", "E", "T0", "PB", "OM", "SINI", "M2",
@@ -329,10 +343,16 @@ class PSRFITS(BaseFile):
             make_par(signal, pulsar, outpar="%s_sim.par" % (pulsar.name))
             parfile = "%s_sim.par" % (pulsar.name)
 
-        polyco_dict = self._gen_polyco(parfile, MJD_start,
-                                       segLength=segLength, ncoeff=15,
-                                       usePINT=usePint, strict=strict_polyco,
-                                       obs_freq=float(signal.fcent.value))
+        # observations longer than one span get a POLYCO TABLE: one fitted
+        # segment per segLength minutes, row-matched by the folding
+        # software (the reference relies on pint.polycos the same way)
+        tobs_s = float(signal.tobs.to("s").value) if signal.tobs is not None \
+            else 0.0
+        polyco_dict = self._gen_polyco(
+            parfile, MJD_start, segLength=segLength, ncoeff=15,
+            usePINT=usePint, strict=strict_polyco,
+            obs_freq=float(signal.fcent.value),
+            duration_min=max(tobs_s / 60.0, segLength))
         primary_dict, subint_dict = self._gen_metadata(
             signal, pulsar, ref_MJD=ref_MJD, inc_len=inc_len
         )

@@ -30,6 +30,7 @@ Phase arithmetic is carried in numpy longdouble (80-bit on x86): with
 
 from __future__ import annotations
 
+import os
 import re
 
 import numpy as np
@@ -155,8 +156,15 @@ def _parse_sexagesimal(val, hours):
     return sign * deg * (15.0 if hours else 1.0) * _DEG
 
 
+# (par fingerprint, strict) -> TimingModel; see TimingModel.from_par
+_MODEL_CACHE = {}
+
+
 class TimingModel:
-    """Deterministic pulsar phase predictor built from a par file."""
+    """Deterministic pulsar phase predictor built from a par file.
+
+    Instances are treated as immutable after construction (from_par
+    memoizes them by file fingerprint); do not mutate a returned model."""
 
     def __init__(self, params, parfile="<par>", strict=True):
         self.params = params
@@ -230,8 +238,25 @@ class TimingModel:
 
     @classmethod
     def from_par(cls, parfile, strict=True):
-        return cls(parse_par_full(parfile), parfile=str(parfile),
-                   strict=strict)
+        """Build from a par file, memoized on (path, mtime, size, strict):
+        multi-segment polyco tables and bulk exports evaluate the same
+        model hundreds of times (one fit per span / file), and parsing a
+        NANOGrav par (hundreds of DMX lines) dominates a single fit."""
+        try:
+            st = os.stat(parfile)
+            key = (os.path.realpath(parfile), st.st_mtime_ns, st.st_size,
+                   bool(strict))
+        except OSError:
+            key = None
+        if key is not None and key in _MODEL_CACHE:
+            return _MODEL_CACHE[key]
+        model = cls(parse_par_full(parfile), parfile=str(parfile),
+                    strict=strict)
+        if key is not None:
+            if len(_MODEL_CACHE) > 64:
+                _MODEL_CACHE.clear()
+            _MODEL_CACHE[key] = model
+        return model
 
     def _init_direction(self, p):
         """Unit vector to the pulsar (equatorial J2000) with proper

@@ -341,3 +341,42 @@ class TestTxtFile:
         TxtFile(path=base).save_psrchive_pdv(sig, psr)
         files = sorted(tmp_path.glob("chunks.ar_*.txt"))
         assert len(files) == 3
+
+
+class TestMultiSegmentPolyco:
+    def test_long_observation_gets_polyco_table(self, tmp_path):
+        # a 300 s observation with 2-minute spans needs ceil(5/2)=3
+        # POLYCO rows; each row's REF_MJD advances by one span and each
+        # segment reproduces the timing model locally
+        from psrsigsim_tpu.io.polyco import generate_polycos, polyco_phase
+        from psrsigsim_tpu.io.timing import TimingModel
+
+        from psrsigsim_tpu.utils import make_quant
+
+        sig, psr = _simulated()
+        sig._tobs = make_quant(300.0, "s")
+        par = str(tmp_path / "seg.par")
+        make_par(sig, psr, outpar=par)
+
+        pcs = generate_polycos(par, 55999.9861, 300.0 / 60.0, segLength=2.0)
+        assert len(pcs) == 3
+        starts = [pc["REF_MJD"] for pc in pcs]
+        assert np.allclose(np.diff(starts), 2.0 / 1440.0)
+        m = TimingModel.from_par(par)
+        for pc in pcs:
+            t = np.longdouble(pc["REF_MJD"]) + np.longdouble(3e-4)
+            direct = float(m.phase(np.atleast_1d(t))[0])
+            pred = polyco_phase(pc, float(t))
+            err = direct - pred
+            assert abs(err - round(err)) < 1e-5
+
+        out = str(tmp_path / "seg.fits")
+        pfit = PSRFITS(path=out, template=TEMPLATE, obs_mode="PSR")
+        pfit.get_signal_params(signal=sig)
+        pfit.save(sig, psr, parfile=par, MJD_start=55999.9861,
+                  segLength=2.0)
+        f = FitsFile.read(out)
+        pol = f["POLYCO"].data
+        assert len(pol) == 3
+        assert np.allclose(np.diff(pol["REF_MJD"]), 2.0 / 1440.0)
+        assert np.all(pol["NSPAN"] == 2.0)
