@@ -196,11 +196,33 @@ class DataPortrait(PulsePortrait):
 
 
 class UserPortrait(PulsePortrait):
-    """User-specified 2-D portrait (stub in the reference,
-    portraits.py:270-275)."""
+    """User-specified 2-D portrait from a callable (stub in the
+    reference, portraits.py:270-275; completed here like the 1-D
+    ``UserProfile`` the reference does implement, profiles.py:118-153).
 
-    def __init__(self):
-        raise NotImplementedError()
+    ``portrait_func(phases, Nchan) -> (Nchan, Nphase)`` evaluates the
+    frequency-resolved intensity at the given phases (in [0, 1)); the
+    base-class normalization (global max across all channels,
+    reference portraits.py:32-45) applies on top.
+    """
+
+    def __init__(self, portrait_func):
+        if not callable(portrait_func):
+            raise TypeError("UserPortrait takes a callable "
+                            "portrait_func(phases, Nchan)")
+        self._generator = portrait_func
+
+    def calc_profiles(self, phases, Nchan=None):
+        ph = np.asarray(phases, dtype=np.float64)
+        if np.any(ph > 1) or np.any(ph < 0):
+            raise ValueError("Phase values must all lie within [0,1].")
+        n = 1 if Nchan is None else int(Nchan)
+        out = np.asarray(self._generator(ph, n), dtype=np.float64)
+        if out.shape != (n, len(ph)):
+            raise ValueError(
+                f"portrait_func returned shape {out.shape}, expected "
+                f"({n}, {len(ph)})")
+        return out
 
 
 def _gaussian_sing_1d(phases, peak, width, amp):

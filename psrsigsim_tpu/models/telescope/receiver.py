@@ -33,8 +33,10 @@ class Receiver:
     """A receiver: flat bandpass (fcent/bandwidth) + receiver temperature
     (reference: receiver.py:12-57).
 
-    Required: EITHER a callable ``response`` (not yet implemented upstream or
-    here) OR ``fcent`` and ``bandwidth`` for a flat response.
+    Required: EITHER a callable ``response`` carrying ``fcent``/
+    ``bandwidth`` attributes in MHz (build one with
+    :func:`response_from_data`; the reference stubs this path,
+    receiver.py:49) OR ``fcent`` and ``bandwidth`` for a flat response.
     """
 
     def __init__(self, response=None, fcent=None, bandwidth=None, Trec=35,
@@ -46,7 +48,16 @@ class Receiver:
         else:
             if fcent is not None or bandwidth is not None:
                 raise ValueError("specify EITHER response OR fcent and bandwidth")
-            raise NotImplementedError("Non-flat response not yet implemented.")
+            # custom bandpass (NotImplemented upstream, receiver.py:49):
+            # the callable must carry its band metadata — use
+            # response_from_data to build one from sampled data
+            fcent = getattr(response, "fcent", None)
+            bandwidth = getattr(response, "bandwidth", None)
+            if fcent is None or bandwidth is None:
+                raise ValueError(
+                    "a custom response callable must carry fcent/bandwidth "
+                    "attributes (MHz); build it with response_from_data")
+            self._response = response
 
         self._Trec = make_quant(Trec, "K")
         self._name = name
@@ -148,9 +159,38 @@ class Receiver:
 
 
 def response_from_data(fs, values):
-    """Generate a callable response function from discrete data (stub in the
-    reference, receiver.py:176-180)."""
-    raise NotImplementedError()
+    """Generate a callable bandpass from sampled (frequency, response)
+    data (stub in the reference, receiver.py:176-180; completed here).
+
+    ``fs`` are frequencies in MHz (monotonically increasing), ``values``
+    the measured response at those frequencies.  Returns a callable
+    ``response(f)`` interpolating linearly inside the sampled band and
+    zero outside it, carrying ``fcent``/``bandwidth`` attributes (the
+    response-weighted band center and the sampled span) so
+    :class:`Receiver` can take it directly in place of a flat band.
+    """
+    fs = np.asarray(fs, dtype=np.float64)
+    values = np.asarray(values, dtype=np.float64)
+    if fs.ndim != 1 or fs.shape != values.shape or fs.size < 2:
+        raise ValueError("fs and values must be matching 1-D arrays "
+                         "with at least two samples")
+    if np.any(np.diff(fs) <= 0):
+        raise ValueError("fs must be strictly increasing")
+
+    def response(f):
+        # .to("MHz") BEFORE .value: make_quant returns compatible
+        # quantities unchanged, so a GHz input must be converted, not
+        # stripped (same handling as _flat_response below)
+        fq = np.asarray(make_quant(f, "MHz").to("MHz").value,
+                        dtype=np.float64)
+        return np.interp(fq, fs, values, left=0.0, right=0.0)
+
+    weight = np.maximum(values, 0.0)
+    wsum = float(np.sum(weight))
+    response.fcent = float(np.sum(fs * weight) / wsum) if wsum > 0 else \
+        float(0.5 * (fs[0] + fs[-1]))
+    response.bandwidth = float(fs[-1] - fs[0])
+    return response
 
 
 def _flat_response(fcent, bandwidth):

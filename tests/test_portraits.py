@@ -143,8 +143,10 @@ class TestProfileWrappers:
         profs = prof.calc_profiles(np.linspace(0, 1, 100), Nchan=3)
         assert profs.shape == (3, 100)
 
-    def test_user_portrait_stub(self):
-        with pytest.raises(NotImplementedError):
+    def test_user_portrait_requires_callable(self):
+        # the reference stubs UserPortrait entirely (portraits.py:270-275);
+        # here it takes a portrait callable (see TestUserPortrait below)
+        with pytest.raises(TypeError):
             UserPortrait()
 
     def test_data_profile_tiles_1d(self):
@@ -173,3 +175,37 @@ class TestProfileWrappers:
         opw = prof._calcOffpulseWindow(Nphase=256)
         assert len(opw) == 2 * (256 // 8 // 2) + 1
         assert prof._max_profile[opw.astype(int)].max() < 1e-6
+
+
+class TestUserPortrait:
+    """UserPortrait from a callable: stub in the reference
+    (portraits.py:270-275), completed in round 3 like the 1-D
+    UserProfile the reference does implement."""
+
+    def test_callable_portrait(self):
+        from psrsigsim_tpu.pulsar import UserPortrait
+
+        def gen(phases, nchan):
+            base = np.exp(-0.5 * ((phases - 0.5) / 0.05) ** 2)
+            scale = 1.0 + 0.1 * np.arange(nchan)
+            return scale[:, None] * base[None, :]
+
+        p = UserPortrait(gen)
+        p.init_profiles(64, Nchan=4)
+        prof = p.profiles
+        assert prof.shape == (4, 64)
+        assert prof.max() == pytest.approx(1.0)  # global-max normalized
+        # channel scaling survives normalization
+        assert prof[3].max() > prof[0].max()
+
+    def test_rejects_bad_shapes_and_phases(self):
+        from psrsigsim_tpu.pulsar import UserPortrait
+
+        with pytest.raises(TypeError):
+            UserPortrait(42)
+        p = UserPortrait(lambda ph, n: np.zeros((n + 1, len(ph))))
+        with pytest.raises(ValueError):
+            p.calc_profiles(np.linspace(0, 0.9, 8), Nchan=2)
+        q = UserPortrait(lambda ph, n: np.zeros((n, len(ph))))
+        with pytest.raises(ValueError):
+            q.calc_profiles(np.array([0.5, 1.5]), Nchan=1)
