@@ -212,6 +212,14 @@ class UserPortrait(PulsePortrait):
                             "portrait_func(phases, Nchan)")
         self._generator = portrait_func
 
+    def init_profiles(self, Nphase, Nchan=None):
+        # like GaussPortrait's override: calc_profiles already divides by
+        # the cached Amax, so no second normalization (which would reset
+        # _Amax to 1 and break later direct calc_profiles calls)
+        ph = np.arange(Nphase) / Nphase
+        self._profiles = self.calc_profiles(ph, Nchan=Nchan)
+        self._max_profile = self._pick_max_profile(self._profiles)
+
     def calc_profiles(self, phases, Nchan=None):
         ph = np.asarray(phases, dtype=np.float64)
         if np.any(ph > 1) or np.any(ph < 0):
@@ -222,7 +230,11 @@ class UserPortrait(PulsePortrait):
             raise ValueError(
                 f"portrait_func returned shape {out.shape}, expected "
                 f"({n}, {len(ph)})")
-        return out
+        # Amax cached on first evaluation and reused, like GaussPortrait
+        # (reference: portraits.py:177): synthesis paths call
+        # calc_profiles directly and rely on max ~ 1 for Smax/noise scales
+        self._Amax = self._Amax if hasattr(self, "_Amax") else np.amax(out)
+        return out / self._Amax
 
 
 def _gaussian_sing_1d(phases, peak, width, amp):

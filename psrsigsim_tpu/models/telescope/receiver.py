@@ -185,10 +185,11 @@ def response_from_data(fs, values):
                         dtype=np.float64)
         return np.interp(fq, fs, values, left=0.0, right=0.0)
 
-    weight = np.maximum(values, 0.0)
-    wsum = float(np.sum(weight))
-    response.fcent = float(np.sum(fs * weight) / wsum) if wsum > 0 else \
-        float(0.5 * (fs[0] + fs[-1]))
+    # fcent/bandwidth describe the SAMPLED band: the midpoint pairs with
+    # the span so [fcent - bw/2, fcent + bw/2] is exactly [fs[0], fs[-1]]
+    # (a response-weighted centroid would shift the implied band off the
+    # sampled one for asymmetric responses)
+    response.fcent = float(0.5 * (fs[0] + fs[-1]))
     response.bandwidth = float(fs[-1] - fs[0])
     return response
 

@@ -5,6 +5,7 @@ under jit/vmap/shard_map.  Signal semantics (delay bookkeeping, guards,
 units) live in the model layer above.
 """
 
+from .channelize import channelize_power
 from .convolve import convolve_profiles, fft_convolve_full
 from .interp import PchipCoeffs, pchip_eval, pchip_fit, pchip_slopes
 from .quantize import clip_cast, subint_dequantize, subint_quantize
@@ -23,6 +24,7 @@ from .window import (
 )
 
 __all__ = [
+    "channelize_power",
     "fourier_shift",
     "coherent_dedisperse",
     "coherent_dedispersion_transfer",

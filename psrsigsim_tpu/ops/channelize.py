@@ -1,0 +1,49 @@
+"""Baseband -> filterbank channelization: one batched STFT power detector.
+
+The reference stubs every signal conversion (`to_FilterBank` raises,
+signal/bb_signal.py:58-76); this implements the baseband -> filterbank
+direction — the physically meaningful one (power detection discards
+phase, so the reverse cannot exist) and the operation real backends
+(GUPPI/PUPPI) perform in FPGAs.
+
+TPU-first shape: the critically-sampled FFT filterbank.  A real voltage
+stream sampled at the Nyquist rate ``2*bw`` is cut into consecutive
+length-``2*nchan`` frames; one batched rFFT turns every frame of every
+polarization into ``nchan`` complex sub-band samples (bins 0..nchan-1 of
+the rfft; the Nyquist bin is dropped), and the detected intensity sums
+``|X|^2`` over polarizations.  Channel k spans
+``[fmin + k*bw/nchan, fmin + (k+1)*bw/nchan)`` and the output sample
+spacing is ``2*nchan / samprate_in`` — exactly the metadata
+``BasebandSignal.to_FilterBank`` stamps on the resulting signal.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["channelize_power"]
+
+
+@partial(jax.jit, static_argnames=("nchan",))
+def channelize_power(data, nchan):
+    """Detect a real baseband stream into filterbank powers.
+
+    Args:
+        data: ``(Npol, nsamp)`` real voltage stream at the Nyquist rate.
+        nchan: number of output frequency channels (frame length is
+            ``2*nchan``).
+
+    Returns:
+        ``(nchan, nsamp // (2*nchan))`` float32 intensity, summed over
+        polarizations (AA+BB), channel 0 at the bottom of the band.
+    """
+    npol, nsamp = data.shape
+    frame = 2 * nchan
+    nframes = nsamp // frame
+    x = data[:, : nframes * frame].reshape(npol, nframes, frame)
+    spec = jnp.fft.rfft(x.astype(jnp.float32), axis=-1)[..., :nchan]
+    power = (spec.real**2 + spec.imag**2).sum(axis=0)  # (nframes, nchan)
+    return power.T.astype(jnp.float32)

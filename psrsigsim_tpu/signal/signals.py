@@ -329,7 +329,59 @@ class BasebandSignal(BaseSignal):
         return self
 
     def to_FilterBank(self, Nsubband=512):
-        raise NotImplementedError()
+        """Channelize the baseband stream into a SEARCH-mode filterbank
+        (stub in the reference, signal/bb_signal.py:58-76; implemented
+        here as the critically-sampled FFT filterbank real backends run
+        — :func:`psrsigsim_tpu.ops.channelize_power`, one batched rFFT
+        over all frames and polarizations).
+
+        Requires data (synthesize with ``Pulsar.make_pulses`` first).
+        Returns a new :class:`FilterBankSignal` with ``Nsubband``
+        channels, sample spacing ``2*Nsubband/samprate``, and the
+        detected AA+BB intensity; the baseband signal is unchanged.
+        """
+        if self._state is None or self._state.data is None:
+            raise ValueError(
+                "no baseband data to channelize; run make_pulses first")
+        from ..ops.channelize import channelize_power
+
+        nchan = int(Nsubband)
+        frame = 2 * nchan
+        nsamp_in = int(self._state.data.shape[-1])
+        if nsamp_in < frame:
+            raise ValueError(
+                f"need at least one frame of 2*Nsubband={frame} samples; "
+                f"have {nsamp_in}")
+        power = channelize_power(self._state.data, nchan)
+        nframes = int(power.shape[1])
+        samprate_in = float(self._samprate.to("MHz").value)
+        # constructed without sample_rate (then overridden) so the
+        # full-band Nyquist warning — meant for user-specified rates —
+        # does not fire on every conversion: the detected stream is
+        # critically sampled per channel by construction
+        out = FilterBankSignal(
+            float(self._fcent.to("MHz").value),
+            float(self._bw.to("MHz").value),
+            Nsubband=nchan,
+            fold=False,
+            dtype=np.float32,
+        )
+        out._samprate = make_quant(samprate_in / frame, "MHz")
+        out.data = power
+        out._nsamp = nframes
+        # tobs reflects the frames actually covered (a partial trailing
+        # frame is dropped by the framing)
+        out._tobs = make_quant(nframes * frame / (samprate_in * 1e6), "s")
+        # observe()/radiometer bookkeeping: one "subint" spanning the
+        # stream (matching the sublen=None SEARCH convention) and the
+        # source signal's flux scale
+        out._nsub = 1
+        out._sublen = out._tobs
+        if getattr(self, "_Smax", None) is not None:
+            out._Smax = self._Smax
+        if self.dm is not None:
+            out._dm = self.dm
+        return out
 
 
 class RFSignal(BaseSignal):

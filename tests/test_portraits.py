@@ -209,3 +209,16 @@ class TestUserPortrait:
         q = UserPortrait(lambda ph, n: np.zeros((n, len(ph))))
         with pytest.raises(ValueError):
             q.calc_profiles(np.array([0.5, 1.5]), Nchan=1)
+
+    def test_synthesis_scale_matches_other_portraits(self):
+        # review regression: direct calc_profiles (the synthesis path)
+        # must return Amax-normalized values like Gauss/Data portraits,
+        # even after init_profiles
+        from psrsigsim_tpu.pulsar import UserPortrait
+
+        p = UserPortrait(lambda ph, n: 50.0 * np.exp(
+            -0.5 * ((ph - 0.5) / 0.05) ** 2)[None, :].repeat(n, axis=0))
+        p.init_profiles(128, Nchan=2)
+        direct = p.calc_profiles(np.arange(128) / 128.0, Nchan=2)
+        assert direct.max() == pytest.approx(1.0)
+        np.testing.assert_allclose(direct, p.profiles)

@@ -118,7 +118,8 @@ class TestBasebandSignal:
         assert s.to_Baseband() is s
         with pytest.raises(NotImplementedError):
             s.to_RF()
-        with pytest.raises(NotImplementedError):
+        # to_FilterBank is implemented (DIVERGENCES #20) but needs data
+        with pytest.raises(ValueError):
             s.to_FilterBank()
 
 
@@ -168,3 +169,100 @@ class TestSignalFactoryAndState:
         st = st.add_delay(jnp.array([1.0, 2.0]))
         st = st.add_delay(jnp.array([0.5, 0.5]))
         np.testing.assert_allclose(np.asarray(st.delay_ms), [1.5, 2.5])
+
+
+class TestBasebandChannelization:
+    """Baseband -> FilterBank conversion (stub in the reference,
+    bb_signal.py:58-76; implemented as a critically-sampled FFT
+    filterbank, ops/channelize.py)."""
+
+    def test_tone_lands_in_the_right_channel(self):
+        import numpy as np
+        from psrsigsim_tpu.ops.channelize import channelize_power
+
+        nchan, nframes = 16, 64
+        fs = 2.0  # samples per unit time; band = [0, 1)
+        t = np.arange(2 * nchan * nframes) / fs
+        # an FFT filterbank's channel k is centered ON rfft bin k:
+        # f = k / (2*nchan) * fs
+        f_tone = 5.0 / (2 * nchan) * fs
+        x = np.cos(2 * np.pi * f_tone * t).astype(np.float32)[None, :]
+        p = np.asarray(channelize_power(x, nchan))
+        assert p.shape == (nchan, nframes)
+        assert np.argmax(p.mean(axis=1)) == 5
+
+    def test_power_conservation(self):
+        import numpy as np
+        from psrsigsim_tpu.ops.channelize import channelize_power
+
+        rng = np.random.default_rng(0)
+        nchan = 8
+        x = rng.normal(size=(2, 2 * nchan * 32)).astype(np.float32)
+        p = np.asarray(channelize_power(x, nchan))
+        # Parseval per frame: sum|X_k|^2 over rfft bins = L/2 * sum x^2
+        # (real input; we drop the Nyquist bin, a small leak)
+        total_time = np.sum(x.astype(np.float64) ** 2)
+        total_freq = np.sum(p) / nchan
+        assert abs(total_freq / total_time - 1.0) < 0.1
+
+    def test_to_filterbank_metadata_and_shape(self):
+        import numpy as np
+        from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+        from psrsigsim_tpu.signal import BasebandSignal
+
+        sig = BasebandSignal(1400.0, 4.0, sample_rate=8.0)
+        psr = Pulsar(0.001, 0.05, GaussProfile(width=0.05), name="C",
+                     seed=0)
+        psr.make_pulses(sig, tobs=0.016384)
+        fb = sig.to_FilterBank(Nsubband=16)
+        assert fb.sigtype == "FilterBankSignal"
+        assert fb.Nchan == 16
+        nframes = int(sig.nsamp) // 32
+        assert np.asarray(fb.data).shape == (16, nframes)
+        assert float(fb.samprate.to("MHz").value) == pytest.approx(
+            8.0 / 32)
+        assert float(fb.dat_freq[0].value) == pytest.approx(1398.0)
+        assert np.all(np.asarray(fb.data) >= 0.0)
+        # the pulse's time structure survives detection: on-pulse frames
+        # carry more power than off-pulse frames
+        prof = np.asarray(fb.data).sum(axis=0)
+        assert prof.max() > 5 * np.median(prof)
+
+    def test_to_filterbank_requires_data(self):
+        from psrsigsim_tpu.signal import BasebandSignal
+
+        sig = BasebandSignal(1400.0, 4.0)
+        with pytest.raises(ValueError):
+            sig.to_FilterBank(Nsubband=8)
+
+    def test_converted_filterbank_survives_observe(self):
+        # review regression: the conversion must stamp the bookkeeping
+        # (nsub/sublen/Smax) that Telescope.observe's radiometer noise
+        # path divides by
+        import numpy as np
+        from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+        from psrsigsim_tpu.signal import BasebandSignal
+        from psrsigsim_tpu.telescope import Backend, Receiver, Telescope
+
+        sig = BasebandSignal(1400.0, 4.0, sample_rate=8.0)
+        psr = Pulsar(0.001, 0.05, GaussProfile(width=0.05), name="C",
+                     seed=1)
+        psr.make_pulses(sig, tobs=0.016384)
+        fb = sig.to_FilterBank(Nsubband=16)
+        t = Telescope(100.0, area=5500.0, Tsys=35.0, name="S")
+        t.add_system("sys", Receiver(fcent=1400, bandwidth=4, name="R"),
+                     Backend(samprate=12.5, name="B"))
+        t.observe(fb, psr, system="sys", noise=True)
+        assert np.isfinite(np.asarray(fb.data)).all()
+        assert fb.nsub == 1
+        assert float(fb.tobs.to("s").value) == pytest.approx(0.016384,
+                                                             rel=1e-6)
+
+    def test_to_filterbank_rejects_too_short_stream(self):
+        import numpy as np
+        from psrsigsim_tpu.signal import BasebandSignal
+
+        sig = BasebandSignal(1400.0, 4.0, sample_rate=8.0)
+        sig.data = np.zeros((2, 100), np.float32)
+        with pytest.raises(ValueError):
+            sig.to_FilterBank(Nsubband=512)  # frame 1024 > 100 samples
