@@ -29,18 +29,23 @@ from .profiles import GaussProfile
 __all__ = ["Pulsar"]
 
 
-@partial(jax.jit, static_argnames=("nsub",))
+@partial(jax.jit, static_argnames=("nsub", "df"))
 def _fold_pulse_kernel(key, profiles, nsub, df, draw_norm):
     """Fold-mode synthesis: tile the portrait to nsub subints and modulate by
-    chi-squared intensity draws (reference: pulsar.py:196-221)."""
+    chi-squared intensity draws (reference: pulsar.py:196-221).
+
+    ``df`` is STATIC: chi2_sample routes small df to the exact gamma
+    sampler and large df to Wilson-Hilferty by VALUE (ops/stats.py); a
+    traced df would erase that routing.  One compile per distinct Nfold
+    is the OO API's natural granularity (one per signal)."""
     block = jnp.tile(profiles, (1, nsub))
     return block * chi2_sample(key, df, block.shape) * draw_norm
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("df",))
 def _power_draw_kernel(key, profiles, df, draw_norm):
     """Single-pulse intensity draws over an evaluated profile block
-    (reference: pulsar.py:222-244, chi2(df=1))."""
+    (reference: pulsar.py:222-244, chi2(df=1)); static ``df`` as above."""
     return profiles * chi2_sample(key, df, profiles.shape) * draw_norm
 
 
@@ -192,7 +197,7 @@ class Pulsar:
                 self._keys.next("pulse"),
                 profiles,
                 signal.nsub,
-                signal.Nfold,
+                float(signal.Nfold),
                 signal._draw_norm,
             )
         else:

@@ -8,6 +8,8 @@ normalization scaling; the scipy global-RNG draws over ``(Nchan, Nsamp)``
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -19,8 +21,10 @@ from ...utils.rng import KeySequence, default_keys
 __all__ = ["Receiver", "response_from_data"]
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("df",))
 def _add_pow_noise_kernel(key, data, df, norm):
+    # df STATIC so chi2_sample's by-value routing (exact gamma for small
+    # df, WH for large) applies — a traced df would silently force WH
     return data + chi2_sample(key, df, data.shape) * norm
 
 
@@ -154,7 +158,8 @@ class Receiver:
     def _add_pow_noise(self, signal, Tsys, gain, pulsar):
         norm, df = self._pow_noise_norm(signal, Tsys, gain, pulsar)
         signal.data = _add_pow_noise_kernel(
-            self._keys.next("noise"), signal.data, jnp.float32(df), jnp.float32(norm)
+            self._keys.next("noise"), signal.data, float(df),
+            jnp.float32(norm)
         )
 
 

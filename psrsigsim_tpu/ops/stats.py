@@ -30,9 +30,75 @@ __all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm",
 SEQ_RNG_BLOCK = 4096
 
 
+# Above this df, chi-squared draws use the Wilson-Hilferty transform of a
+# single normal instead of the gamma rejection sampler.  WH is the
+# classical cube-of-a-normal approximation chi2_k ~ k*(1 - 2/(9k) +
+# Z*sqrt(2/(9k)))^3: at k=50 its quantiles are accurate to ~2e-3 and its
+# mean is exact to O(k^-2) (E = k*(1 - (2/(9k))^3)); at the fold-mode
+# dfs this framework draws (Nfold = sublen/period, typically 50-12000,
+# reference pulsar.py:214) it is statistically indistinguishable from
+# exact chi-squared (tests/test_stats_wh.py) — and ~6x cheaper than
+# jax.random.gamma's rejection loop, which dominates honest fold-mode
+# pipeline time.  Set PSS_EXACT_CHI2=1 (read at trace time) to force the
+# exact gamma sampler everywhere.
+CHI2_WH_MIN_DF = 50.0
+
+
+def _exact_chi2(key, df, shape, dtype):
+    return 2.0 * jax.random.gamma(key, jnp.asarray(df, dtype) / 2.0, shape,
+                                  dtype)
+
+
+def _wilson_hilferty_chi2(key, df, shape, dtype):
+    z = jax.random.normal(key, shape, dtype)
+    k = jnp.asarray(df, dtype)
+    c = 2.0 / (9.0 * k)
+    x = k * (1.0 - c + z * jnp.sqrt(c)) ** 3
+    # chi2 support is [0, inf); for df >= 50 the clamp is a >14-sigma event
+    return jnp.maximum(x, 0.0)
+
+
 def chi2_sample(key, df, shape, dtype=jnp.float32):
-    """Sample from a chi-squared distribution with (possibly fractional) df."""
-    return 2.0 * jax.random.gamma(key, jnp.asarray(df, dtype) / 2.0, shape, dtype)
+    """Sample from a chi-squared distribution with (possibly fractional) df.
+
+    Static ``df >= CHI2_WH_MIN_DF`` uses the Wilson-Hilferty normal
+    transform (see above); static small df uses the exact gamma sampler.
+    A TRACED ``df`` (the heterogeneous multi-pulsar pipeline, where
+    df = Nfold per pulsar) uses WH — the staging layer guarantees
+    ``Nfold >= CHI2_WH_MIN_DF`` there (parallel/ensemble.py); export
+    ``PSS_EXACT_CHI2=1`` to trace the exact sampler instead.
+    """
+    import os
+
+    if os.environ.get("PSS_EXACT_CHI2"):
+        # the escape hatch means what it says: gamma streams EVERYWHERE
+        # (including df=1), for bit-compatibility with exact-mode outputs
+        return _exact_chi2(key, df, shape, dtype)
+    try:
+        static_df = float(df)  # raises for traced values
+    except Exception:
+        static_df = None
+    if static_df == 1.0:
+        # chi2(1) IS the square of a standard normal — EXACT in
+        # distribution and ~6x cheaper than the gamma rejection sampler;
+        # df=1 is every SEARCH-mode draw (reference receiver.py:160-164)
+        z = jax.random.normal(key, shape, dtype)
+        return z * z
+    if static_df is not None:
+        if static_df < CHI2_WH_MIN_DF:
+            return _exact_chi2(key, df, shape, dtype)
+        return _wilson_hilferty_chi2(key, df, shape, dtype)
+    # traced df: value-based routing is impossible at trace time, and a
+    # lax.select against the gamma sampler would pay its cost for every
+    # element.  Select in-graph between the exact df=1 identity and WH —
+    # correct for the two traced-df uses this framework has (the hetero
+    # fold pipeline, whose staging guards Nfold >= CHI2_WH_MIN_DF, and
+    # any future df=1 traced caller); both share one normal field.
+    z = jax.random.normal(key, shape, dtype)
+    k = jnp.asarray(df, dtype)
+    c = 2.0 / (9.0 * k)
+    wh = jnp.maximum(k * (1.0 - c + z * jnp.sqrt(c)) ** 3, 0.0)
+    return jnp.where(k == 1.0, z * z, wh)
 
 
 def normal_sample(key, shape, dtype=jnp.float32):

@@ -36,6 +36,25 @@ except AttributeError:  # pragma: no cover
 __all__ = ["FoldEnsemble", "MultiPulsarFoldEnsemble"]
 
 
+def _check_hetero_nfolds(nfolds):
+    """The hetero pipeline traces its chi2 df (= Nfold per pulsar), so
+    draws go through the Wilson-Hilferty path unconditionally
+    (ops/stats.py); guarantee its validity domain at staging time."""
+    import os
+
+    from ..ops.stats import CHI2_WH_MIN_DF
+
+    if not os.environ.get("PSS_EXACT_CHI2") and np.min(nfolds) < CHI2_WH_MIN_DF:
+        raise ValueError(
+            f"heterogeneous ensemble has Nfold={float(np.min(nfolds)):.1f} "
+            f"< {CHI2_WH_MIN_DF:.0f}: the traced-df chi2 draws use the "
+            "Wilson-Hilferty approximation, only valid for large df. Use "
+            "longer subintegrations, or export PSS_EXACT_CHI2=1 for the "
+            "exact (slower) gamma sampler."
+        )
+    return nfolds
+
+
 class FoldEnsemble:
     """A sharded fold-mode Monte-Carlo ensemble.
 
@@ -514,8 +533,9 @@ class MultiPulsarFoldEnsemble:
                 np.asarray([self.workloads[i][2] for i in padded], np.float32),
                 obs_sh),
             nfolds=jax.device_put(
-                np.asarray([self.workloads[i][0].nfold for i in padded],
-                           np.float32), obs_sh),
+                _check_hetero_nfolds(
+                    np.asarray([self.workloads[i][0].nfold for i in padded],
+                               np.float32)), obs_sh),
             draw_norms=jax.device_put(
                 np.asarray([self.workloads[i][0].draw_norm for i in padded],
                            np.float32), obs_sh),

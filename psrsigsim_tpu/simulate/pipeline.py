@@ -191,6 +191,12 @@ def fold_pipeline_hetero(key, dm, noise_norm, nfold, draw_norm, profiles, cfg,
     Args: as :func:`fold_pipeline` plus traced ``nfold``/``draw_norm`` and
     optional traced ``dt_ms`` (defaults to the static ``cfg.dt_ms``).
     Returns ``(Nchan, nsub*Nph)`` float32.
+
+    Because ``nfold`` is traced, the chi-squared draws route through the
+    Wilson-Hilferty transform unconditionally (ops/stats.py), valid for
+    ``nfold >= CHI2_WH_MIN_DF`` — :class:`MultiPulsarFoldEnsemble`
+    guards that at staging; direct callers must honor it too (or export
+    ``PSS_EXACT_CHI2=1``).
     """
     return _fold_core(key, dm, noise_norm, nfold, draw_norm, nfold, profiles,
                       cfg, freqs, chan_ids, extra_delays_ms, dt_ms=dt_ms)

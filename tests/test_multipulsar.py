@@ -190,7 +190,8 @@ class TestPadNbin:
     def test_distinct_periods_collapse_to_few_buckets(self):
         # 8 DISTINCT periods; natural nph would make 8 buckets/programs
         rng = np.random.default_rng(0)
-        periods = 0.004 + 0.008 * rng.random(8)
+        # Nfold = 0.5/period must stay >= 50 (WH chi2 validity guard)
+        periods = 0.004 + 0.005 * rng.random(8)
         sims = [_sim_for(p, 10.0 + 5 * i) for i, p in enumerate(periods)]
         ens = MultiPulsarFoldEnsemble.from_simulations(
             sims, pad_nbin=[1024, 2048, 4096])

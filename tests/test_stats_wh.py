@@ -1,0 +1,127 @@
+"""Wilson-Hilferty chi-squared sampling (ops/stats.py): statistical
+equivalence to the exact distribution at the dfs the framework draws
+(fold-mode Nfold = sublen/period, reference pulsar.py:214), and the
+static-df routing between the exact gamma sampler and the WH transform."""
+
+import os
+
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import jax
+import jax.numpy as jnp
+
+from psrsigsim_tpu.ops.stats import (
+    CHI2_WH_MIN_DF,
+    _exact_chi2,
+    _wilson_hilferty_chi2,
+    chi2_sample,
+)
+
+
+class TestWilsonHilferty:
+    @pytest.mark.parametrize("df", [50.0, 200.0, 12000.0])
+    def test_moments_match_chi2(self, df):
+        n = 400_000
+        x = np.asarray(chi2_sample(jax.random.key(0), df, (n,)))
+        # mean ±4 sigma of the sample-mean distribution; var within 3%
+        tol = 4.0 * np.sqrt(2 * df / n)
+        assert abs(x.mean() - df) < tol
+        assert abs(x.var() / (2 * df) - 1.0) < 0.03
+
+    @pytest.mark.parametrize("df", [50.0, 200.0])
+    def test_ks_against_scipy_cdf(self, df):
+        n = 200_000
+        x = np.asarray(chi2_sample(jax.random.key(1), df, (n,)))
+        d, _ = sps.kstest(x, lambda v: sps.chi2.cdf(v, df))
+        # WH's intrinsic KS distance at df=50 is ~1.5e-3; sampling noise
+        # at n=200k is ~0.003 — 0.01 catches a broken transform without
+        # flaking
+        assert d < 0.01
+
+    def test_df1_is_squared_normal(self):
+        # df=1 (SEARCH synthesis/noise, reference receiver.py:160-164)
+        # draws the EXACT distribution as the square of a standard normal
+        a = np.asarray(chi2_sample(jax.random.key(2), 1.0, (100_000,)))
+        z = np.asarray(jax.random.normal(jax.random.key(2), (100_000,)))
+        np.testing.assert_array_equal(a, z * z)
+        d, _ = sps.kstest(a, lambda v: sps.chi2.cdf(v, 1.0))
+        assert d < 0.01
+
+    def test_small_df_between_1_and_threshold_stays_exact_gamma(self):
+        a = np.asarray(chi2_sample(jax.random.key(2), 5.0, (100_000,)))
+        b = np.asarray(_exact_chi2(jax.random.key(2), 5.0, (100_000,),
+                                   jnp.float32))
+        np.testing.assert_array_equal(a, b)
+        d, _ = sps.kstest(a, lambda v: sps.chi2.cdf(v, 5.0))
+        assert d < 0.01
+
+    def test_large_df_routes_to_wh(self):
+        a = np.asarray(chi2_sample(jax.random.key(3), 12000.0, (1000,)))
+        b = np.asarray(_wilson_hilferty_chi2(jax.random.key(3), 12000.0,
+                                             (1000,), jnp.float32))
+        np.testing.assert_array_equal(a, b)
+
+    def test_exact_env_escape_hatch(self):
+        os.environ["PSS_EXACT_CHI2"] = "1"
+        try:
+            a = np.asarray(chi2_sample(jax.random.key(4), 12000.0, (1000,)))
+            b = np.asarray(_exact_chi2(jax.random.key(4), 12000.0, (1000,),
+                                       jnp.float32))
+            np.testing.assert_array_equal(a, b)
+        finally:
+            del os.environ["PSS_EXACT_CHI2"]
+
+    def test_traced_df_uses_wh(self):
+        f = jax.jit(lambda df: chi2_sample(jax.random.key(5), df, (1000,)))
+        a = np.asarray(f(jnp.float32(500.0)))
+        b = np.asarray(_wilson_hilferty_chi2(jax.random.key(5), 500.0,
+                                             (1000,), jnp.float32))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_non_negative(self):
+        x = np.asarray(chi2_sample(jax.random.key(6), CHI2_WH_MIN_DF,
+                                   (500_000,)))
+        assert x.min() >= 0.0
+
+
+class TestHeteroStagingGuard:
+    def test_small_nfold_rejected_without_exact_mode(self):
+        from psrsigsim_tpu.parallel.ensemble import _check_hetero_nfolds
+
+        with pytest.raises(ValueError):
+            _check_hetero_nfolds(np.asarray([100.0, 10.0], np.float32))
+        ok = _check_hetero_nfolds(np.asarray([60.0, 100.0], np.float32))
+        assert ok.min() >= CHI2_WH_MIN_DF
+
+    def test_small_nfold_allowed_in_exact_mode(self):
+        from psrsigsim_tpu.parallel.ensemble import _check_hetero_nfolds
+
+        os.environ["PSS_EXACT_CHI2"] = "1"
+        try:
+            _check_hetero_nfolds(np.asarray([10.0], np.float32))
+        finally:
+            del os.environ["PSS_EXACT_CHI2"]
+
+
+class TestTracedAndKernelRouting:
+    def test_traced_df1_selects_squared_normal(self):
+        # review regression: traced df must not silently apply WH at df=1
+        f = jax.jit(lambda df: chi2_sample(jax.random.key(7), df, (50_000,)))
+        a = np.asarray(f(jnp.float32(1.0)))
+        z = np.asarray(jax.random.normal(jax.random.key(7), (50_000,)))
+        np.testing.assert_allclose(a, z * z, rtol=1e-6)
+
+    def test_oo_kernels_route_statically(self):
+        # review regression: the jitted object-API kernels previously
+        # passed df as a traced arg, silently forcing WH at df=1; they
+        # now pass it statically, so SEARCH draws are exact chi2(1)
+        from psrsigsim_tpu.models.pulsar.pulsar import _power_draw_kernel
+
+        prof = jnp.ones((4, 10_000), jnp.float32)
+        key = jax.random.key(8)
+        out = np.asarray(_power_draw_kernel(key, prof, 1.0,
+                                            jnp.float32(1.0)))
+        d, _ = sps.kstest(out.ravel(), lambda v: sps.chi2.cdf(v, 1.0))
+        assert d < 0.02
