@@ -25,8 +25,9 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "encode_available", "encode_subints",
-           "format_pdv_block"]
+__all__ = ["available", "encode_available", "encode_preferred",
+           "encode_speed_probe", "encode_subints", "format_pdv_block",
+           "median3"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "encode.cpp")
@@ -146,6 +147,68 @@ def encode_available():
             got = encode_subints(probe, 1, probe.shape[1])[0, 0]
             _cast_ok = bool(np.array_equal(got, expect))
     return _cast_ok
+
+
+_speed_ok = None
+
+
+def median3(fn):
+    """Warm once, then median of 3 timed runs — the measurement rule
+    shared by the load-time encode speed gate and the bench report (so
+    the two can never disagree on policy)."""
+    import time as _time
+
+    ts = []
+    fn()  # warm caches/branch predictors
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        fn()
+        ts.append(_time.perf_counter() - t0)
+    ts.sort()
+    return ts[1]
+
+
+def encode_preferred():
+    """True when the native subint encode should actually be USED: it is
+    available, byte-identical (:func:`encode_available`), and MEASURED
+    faster than the numpy cast on this host.
+
+    Round-3 driver record (BENCH_r03.json io_encode) caught the native
+    path running 0.68x the numpy path on that machine while the gate was
+    compile-success only — so every export took the slow path on purpose.
+    Speed is now probed once per process on a representative block
+    (~8 MB, a few ms per side, median of 3) and the faster path wins;
+    ``PSS_NO_NATIVE=1`` still disables natively outright.
+    """
+    global _speed_ok
+    if not encode_available():
+        return False
+    with _lock:
+        if _speed_ok is None:
+            rng = np.random.default_rng(7)
+            nchan, nsub, nbin = 256, 4, 2048
+            data = rng.normal(0, 50, (nchan, nsub * nbin)).astype(np.float32)
+
+            def _numpy():
+                out = np.empty((nsub, 1, nchan, nbin), dtype=">i2")
+                with np.errstate(invalid="ignore"):
+                    for ii in range(nsub):
+                        out[ii, 0] = data[:, ii * nbin:(ii + 1) * nbin
+                                          ].astype(">i2")
+                return out
+
+            t_nat = median3(lambda: encode_subints(data, nsub, nbin))
+            t_np = median3(_numpy)
+            # require a real margin: a photo-finish should keep the
+            # simpler numpy path
+            _speed_ok = bool(t_nat < 0.9 * t_np)
+    return _speed_ok
+
+
+def encode_speed_probe():
+    """The cached result of :func:`encode_preferred`'s measurement (None
+    when not probed yet) — surfaced for the bench report."""
+    return _speed_ok
 
 
 def encode_subints(data, nsub, nbin, npol=1):

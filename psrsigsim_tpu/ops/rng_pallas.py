@@ -1,0 +1,274 @@
+"""Hardware-PRNG field samplers (Pallas/Mosaic TPU kernels).
+
+The honest round-3 profile showed fold/SEARCH pipelines are *random-draw
+bound*: the two chi-squared fields per observation cost ~4.5 ms of a
+~6 ms observation through ``jax.random``'s threefry counter PRNG
+(~1.2 Gsamples/s on a v5e).  The TPU VPU has a hardware PRNG
+(`tpu.prng_random_bits`) that emits raw bits at effectively memory
+speed; this module fuses
+
+    hardware bits -> uniform -> Box-Muller normal -> (chi2 transform)
+
+in one Pallas kernel, producing finished chi-squared / normal fields at
+>20 Gsamples/s — the "fused counter-RNG+transform sampler" named as the
+round-3 bottleneck in docs/performance.md.
+
+Stream structure (sharding invariance)
+--------------------------------------
+Draws are seeded per ``(channel-group, RNG block)`` where a channel
+group is 8 consecutive GLOBAL channels (one VPU sublane tile) and an
+RNG block is ``SEQ_RNG_BLOCK`` (=4096) consecutive GLOBAL time samples
+— the same global-block philosophy as the threefry path
+(:mod:`psrsigsim_tpu.ops.stats`), so the assembled stream is
+bit-identical for any mesh shape provided shards are aligned to 8
+channels x 4096 samples (every sharding this framework builds is; the
+dispatcher falls back to the threefry path otherwise).
+
+The hardware sampler draws a DIFFERENT stream than threefry — selecting
+a sampler selects a random realization, never the statistics
+(DIVERGENCES #23).  The
+distribution is exact where the threefry path is exact (normal fields,
+chi2 via squared-normal at df=1) and Wilson-Hilferty at large df, the
+same routing as :func:`psrsigsim_tpu.ops.stats.chi2_sample`.
+
+Batching: ensembles vmap the per-observation pipelines (sometimes twice
+— pulsars x epochs).  ``pallas_call`` does not batch through arbitrary
+block specs, so the public entry points are ``jax.custom_batching``
+functions whose vmap rule flattens any number of leading batch axes
+into the kernel's own grid dimension.
+
+Reference replaced: scipy global-RNG draws in psrsigsim/pulsar/
+pulsar.py:215-244 and telescope/receiver.py:160-171.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "RNG_BLOCK",
+    "CHAN_GROUP",
+    "hw_sampler_supported",
+    "hw_chan_field",
+]
+
+RNG_BLOCK = 4096  # must equal ops.stats.SEQ_RNG_BLOCK
+CHAN_GROUP = 8    # VPU sublane count: channels per independent hw stream
+_MAX_TILE_BLOCKS = 8  # time blocks per kernel invocation (VMEM bound)
+
+# int32 two's-complement images of the murmur3/splitmix mixing constants
+_M1 = int(np.int32(np.uint32(0x85EBCA6B)))
+_M2 = int(np.int32(np.uint32(0xC2B2AE35)))
+_GOLD = int(np.int32(np.uint32(0x9E3779B9)))
+_TWO_PI = float(2.0 * np.pi)
+
+
+def hw_sampler_supported():
+    """True when the current default backend can run the Mosaic kernels."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - uninitialized backend
+        return False
+
+
+def _mix32(h):
+    """murmur3 finalizer: full avalanche on 32 bits (int32 wraparound)."""
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    h = h * _M1
+    h = h ^ jax.lax.shift_right_logical(h, 13)
+    h = h * _M2
+    return h ^ jax.lax.shift_right_logical(h, 16)
+
+
+def _kernel(seed_ref, df_ref, pos_ref, o_ref, *, mode, nblk_tile):
+    """One (batch element, channel group, time tile): seed the hardware
+    PRNG per (global channel group, global RNG block), draw bits, and
+    transform in registers."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bi = jax.lax.convert_element_type(_pl().program_id(0), jnp.int32)
+    cgi = jax.lax.convert_element_type(_pl().program_id(1), jnp.int32)
+    ti = jax.lax.convert_element_type(_pl().program_id(2), jnp.int32)
+
+    s0 = seed_ref[bi, 0]
+    s1 = seed_ref[bi, 1]
+    cg = pos_ref[bi, 0] + cgi
+    base_b = pos_ref[bi, 1] + ti * nblk_tile
+    k = df_ref[bi]
+
+    mask24 = jnp.int32(0x00FFFFFF)
+    inv24 = jnp.float32(2.0**-24)
+
+    for lb in range(nblk_tile):  # static unroll, <= _MAX_TILE_BLOCKS
+        b = base_b + lb
+        # joint avalanche over (user seed, channel group, block): adjacent
+        # (cg, b) pairs land in unrelated hardware streams
+        h0 = _mix32(s0 ^ (cg * _GOLD + 0x5851))
+        h1 = _mix32(s1 ^ (b * _M1) ^ (cg * _M2 + 0x7F4A))
+        pltpu.prng_seed(h0, h1)
+        bits1 = pltpu.prng_random_bits((CHAN_GROUP, RNG_BLOCK))
+        bits2 = pltpu.prng_random_bits((CHAN_GROUP, RNG_BLOCK))
+        # 24-bit uniforms: u1 in (0, 1] (log-safe), u2 in [0, 1)
+        u1 = ((bits1 & mask24).astype(jnp.float32) + 1.0) * inv24
+        u2 = (bits2 & mask24).astype(jnp.float32) * inv24
+        # Box-Muller (cos branch): exact standard normal from two uniforms
+        z = jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(jnp.float32(_TWO_PI) * u2)
+        if mode == "normal":
+            val = z
+        elif mode == "chi2_1":
+            val = z * z
+        else:
+            # Wilson-Hilferty cube (ops/stats.py CHI2_WH_MIN_DF domain)
+            c = 2.0 / (9.0 * k)
+            wh = jnp.maximum(k * (1.0 - c + z * jnp.sqrt(c)) ** 3, 0.0)
+            if mode == "chi2_wh":
+                val = wh
+            elif mode == "chi2_sel":  # traced df: df==1 must stay exact
+                val = jnp.where(k == 1.0, z * z, wh)
+            else:  # pragma: no cover - factory guards modes
+                raise ValueError(f"unknown sampler mode {mode!r}")
+        o_ref[0, :, lb * RNG_BLOCK : (lb + 1) * RNG_BLOCK] = val
+
+
+def _pl():
+    from jax.experimental import pallas as pl
+
+    return pl
+
+
+def _tile_blocks(nblk):
+    """Largest tile size (in RNG blocks) that divides the span."""
+    for t in range(min(_MAX_TILE_BLOCKS, nblk), 0, -1):
+        if nblk % t == 0:
+            return t
+    return 1
+
+
+@lru_cache(maxsize=None)
+def _batched_field_fn(mode, nchan, length, interpret):
+    """(B,2) seeds, (B,) dfs, (B,2) pos -> (B, nchan, length) fields, with
+    a vmap rule that flattens extra batch axes into B (arbitrary nesting)."""
+    pl = _pl()
+    from jax.experimental.pallas import tpu as pltpu
+
+    cpad = -(-nchan // CHAN_GROUP) * CHAN_GROUP
+    nblk = -(-length // RNG_BLOCK)
+    spad = nblk * RNG_BLOCK
+    tb = _tile_blocks(nblk)
+    tile = tb * RNG_BLOCK
+    kern = partial(_kernel, mode=mode, nblk_tile=tb)
+
+    def _impl(seeds, dfs, pos):
+        B = seeds.shape[0]
+        # under shard_map (check_vma=True) the out aval must declare which
+        # mesh axes it varies over: exactly the union of the inputs'
+        # (keys vary over the obs axis, chan0/b0 over chan/seq axes)
+        vma = frozenset()
+        for a in (seeds, dfs, pos):
+            try:
+                vma = vma | jax.typeof(a).vma
+            except (AttributeError, TypeError):
+                pass
+        try:
+            out_aval = jax.ShapeDtypeStruct((B, cpad, spad), jnp.float32,
+                                            vma=vma)
+        except TypeError:  # pragma: no cover - jax without vma kwarg
+            out_aval = jax.ShapeDtypeStruct((B, cpad, spad), jnp.float32)
+        out = pl.pallas_call(
+            kern,
+            grid=(B, cpad // CHAN_GROUP, spad // tile),
+            out_shape=out_aval,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, CHAN_GROUP, tile), lambda bi, cgi, ti: (bi, cgi, ti)
+            ),
+            interpret=(pltpu.InterpretParams() if interpret else False),
+        )(seeds, dfs, pos)
+        if cpad != nchan or spad != length:
+            out = out[:, :nchan, :length]
+        return out
+
+    @jax.custom_batching.custom_vmap
+    def fnb(seeds, dfs, pos):
+        return _impl(seeds, dfs, pos)
+
+    @fnb.def_vmap
+    def _rule(axis_size, in_batched, seeds, dfs, pos):  # noqa: ANN001
+        A = axis_size
+        if not in_batched[0]:
+            seeds = jnp.broadcast_to(seeds[None], (A,) + seeds.shape)
+        if not in_batched[1]:
+            dfs = jnp.broadcast_to(dfs[None], (A,) + dfs.shape)
+        if not in_batched[2]:
+            pos = jnp.broadcast_to(pos[None], (A,) + pos.shape)
+        B = seeds.shape[1]
+        out = fnb(
+            seeds.reshape(A * B, 2),
+            dfs.reshape(A * B),
+            pos.reshape(A * B, 2),
+        )
+        return out.reshape(A, B, nchan, length), True
+
+    return fnb
+
+
+def hw_chan_field(key, chan0, df, t0, *, mode, nchan, length,
+                  interpret=False):
+    """A ``(nchan, length)`` random field from the hardware sampler.
+
+    Args:
+        key: jax PRNG key (any impl; its 2x32-bit key data seeds the
+            stream).  May be traced/batched.
+        chan0: GLOBAL index of the first channel; must be a multiple of
+            :data:`CHAN_GROUP` and the channels contiguous (the caller's
+            promise — every slab sharding in this framework qualifies).
+            Traced OK.
+        df: chi-squared degrees of freedom (ignored for mode="normal"
+            and mode="chi2_1").  Traced OK.
+        t0: GLOBAL time sample of the first column; must be a multiple of
+            :data:`RNG_BLOCK` (caller's promise).  Traced OK.
+        mode: "normal" | "chi2_1" | "chi2_wh" | "chi2_sel" (static).
+        nchan, length: output shape (static).
+        interpret: run the kernel in Pallas interpret mode (tests only;
+            the interpret-mode hardware PRNG is a stub that returns
+            zeros, so only shapes/plumbing are checkable off-TPU).
+
+    vmap over (key[, df]) batches into the kernel grid — any nesting
+    depth — via the custom_vmap rule above.
+    """
+    kd = jax.random.key_data(key)
+    seeds = jax.lax.bitcast_convert_type(
+        kd.astype(jnp.uint32), jnp.int32
+    ).reshape(2)
+    cg0 = jnp.asarray(chan0, jnp.int32) // CHAN_GROUP
+    b0 = jnp.asarray(t0, jnp.int32) // RNG_BLOCK
+    pos = jnp.stack([cg0, b0])
+    dfs = jnp.asarray(df, jnp.float32).reshape(())
+    fnb = _batched_field_fn(mode, int(nchan), int(length), bool(interpret))
+
+    @jax.custom_batching.custom_vmap
+    def fn1(seeds, dfv, pos):
+        return fnb(seeds[None], dfv[None], pos[None])[0]
+
+    @fn1.def_vmap
+    def _rule(axis_size, in_batched, seeds, dfv, pos):  # noqa: ANN001
+        A = axis_size
+        if not in_batched[0]:
+            seeds = jnp.broadcast_to(seeds[None], (A, 2))
+        if not in_batched[1]:
+            dfv = jnp.broadcast_to(dfv[None], (A,))
+        if not in_batched[2]:
+            pos = jnp.broadcast_to(pos[None], (A, 2))
+        return fnb(seeds, dfv, pos), True
+
+    return fn1(seeds, dfs, pos)

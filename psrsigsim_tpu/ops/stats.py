@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm",
-           "SEQ_RNG_BLOCK", "blocked_chan_chi2", "blocked_chan_normal"]
+           "SEQ_RNG_BLOCK", "blocked_chan_chi2", "blocked_chan_normal",
+           "sampler_backend", "chan_chi2_field", "chan_normal_field"]
 
 # Fixed span of global time samples per RNG key: ALL pipeline draws —
 # unsharded and sequence-sharded alike — are keyed by
@@ -152,6 +153,108 @@ def blocked_chan_normal(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK,
     return _blocked_chan_draw(
         normal_sample, key, chan_ids, t0, length, block, aligned,
     )
+
+
+def sampler_backend():
+    """Which field sampler the jitted pipelines trace: ``"hw"`` (the Pallas
+    hardware-PRNG kernels of :mod:`psrsigsim_tpu.ops.rng_pallas`) or
+    ``"threefry"`` (the blocked ``jax.random`` draws above).
+
+    Resolution, read at trace time:
+
+    * ``PSS_SAMPLER=threefry`` or ``PSS_SAMPLER=hw`` forces a backend;
+    * ``PSS_EXACT_CHI2=1`` forces threefry (the exact-gamma escape hatch
+      must control every draw);
+    * otherwise ``auto``: hardware when the default backend is a TPU.
+
+    The two backends draw DIFFERENT (equally valid) streams; sharding
+    invariance holds within each backend (the hardware sampler keys by
+    (8-channel group, 4096-sample global block) — see rng_pallas).
+    """
+    import os
+
+    env = os.environ.get("PSS_SAMPLER", "auto")
+    if env == "threefry":
+        return "threefry"
+    if os.environ.get("PSS_EXACT_CHI2"):
+        return "threefry"
+    if env == "hw":
+        return "hw"
+    if env != "auto":
+        raise ValueError(f"PSS_SAMPLER={env!r}: use 'auto', 'hw' or 'threefry'")
+    from .rng_pallas import hw_sampler_supported
+
+    return "hw" if hw_sampler_supported() else "threefry"
+
+
+def _hw_chi2_mode(df):
+    """Map a chi2 df to a hardware-kernel transform mode (or None when the
+    hardware path cannot reproduce :func:`chi2_sample`'s routing exactly:
+    static small df uses the exact gamma sampler, which stays threefry)."""
+    try:
+        static_df = float(df)
+    except Exception:
+        return "chi2_sel"  # traced df: same select as chi2_sample
+    if static_df == 1.0:
+        return "chi2_1"
+    if static_df >= CHI2_WH_MIN_DF:
+        return "chi2_wh"
+    return None
+
+
+def _hw_field_span(key, chan_ids, dfv, t0, mode, length, aligned):
+    """Hardware-sampler draws for a possibly block-UNALIGNED global span:
+    draw the whole RNG blocks covering ``[t0, t0+length)`` (one block of
+    overdraw when unaligned — the same scheme as the threefry path) and
+    slice the span out, so the assembled stream is identical for ANY
+    slab boundaries, aligned or not."""
+    from .rng_pallas import RNG_BLOCK, hw_chan_field
+
+    nchan = int(chan_ids.shape[0])
+    if isinstance(t0, (int, np.integer)):
+        aligned = (t0 % RNG_BLOCK == 0)
+    if aligned:
+        return hw_chan_field(key, chan_ids[0], dfv, t0, mode=mode,
+                             nchan=nchan, length=length)
+    pad_len = (-(-length // RNG_BLOCK) + 1) * RNG_BLOCK
+    b0 = jnp.asarray(t0, jnp.int32) // RNG_BLOCK
+    field = hw_chan_field(key, chan_ids[0], dfv, b0 * RNG_BLOCK, mode=mode,
+                          nchan=nchan, length=pad_len)
+    off = jnp.asarray(t0, jnp.int32) - b0 * RNG_BLOCK
+    return lax.dynamic_slice(field, (jnp.int32(0), off), (nchan, length))
+
+
+def chan_chi2_field(key, chan_ids, df, t0, length, block=SEQ_RNG_BLOCK,
+                    aligned=False):
+    """Per-channel chi-squared field draws — the pipelines' entry point.
+
+    Dispatches between the Pallas hardware sampler (TPU; see
+    :func:`sampler_backend`) and the blocked threefry draws.  The chosen
+    backend NEVER depends on span alignment (unaligned spans overdraw one
+    RNG block and slice, both backends), so shard-count invariance holds
+    on either backend.  ``chan_ids`` must be CONTIGUOUS global channel
+    indices; on the hardware path the first id should be a multiple of
+    :data:`~psrsigsim_tpu.ops.rng_pallas.CHAN_GROUP` for cross-shard
+    stream equality (every slab sharding in this framework qualifies; a
+    misaligned slab still draws valid statistics, just a shard-dependent
+    realization).
+    """
+    if sampler_backend() == "hw" and block == SEQ_RNG_BLOCK:
+        mode = _hw_chi2_mode(df)
+        if mode is not None:
+            dfv = 0.0 if mode == "chi2_1" else df
+            return _hw_field_span(key, chan_ids, dfv, t0, mode, length,
+                                  aligned)
+    return blocked_chan_chi2(key, chan_ids, df, t0, length, block, aligned)
+
+
+def chan_normal_field(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK,
+                      aligned=False):
+    """Per-channel standard-normal field draws (see :func:`chan_chi2_field`)."""
+    if sampler_backend() == "hw" and block == SEQ_RNG_BLOCK:
+        return _hw_field_span(key, chan_ids, 0.0, t0, "normal", length,
+                              aligned)
+    return blocked_chan_normal(key, chan_ids, t0, length, block, aligned)
 
 
 def chi2_draw_norm(dtype, df):

