@@ -39,7 +39,8 @@ __all__ = [
     "tdb_from_utc", "tdb_minus_utc_seconds", "earth_heliocentric",
     "sun_ssb_offset",
     "observatory_itrf", "observatory_ssb", "solve_kepler",
-    "OBSERVATORIES", "UnknownObservatoryError",
+    "OBSERVATORIES", "UnknownObservatoryError", "register_observatory",
+    "load_tempo_obsys",
 ]
 
 # -- constants ---------------------------------------------------------------
@@ -503,8 +504,11 @@ class UnknownObservatoryError(ValueError):
     """Site code has no ITRF entry; polyco generation must not guess."""
 
 
-# ITRF geocentric coordinates (meters), standard TEMPO obsys values
-# (~10 m accuracy -> ~30 ns of delay; irrelevant at this error budget).
+# ITRF geocentric coordinates (meters), standard TEMPO/tempo2 obsys values
+# (~10-100 m accuracy -> <0.3 us of geometric delay; irrelevant at this
+# error budget).  Only sites with well-published coordinates are baked in;
+# anything else arrives via register_observatory / load_tempo_obsys /
+# explicit xyz (below) and otherwise fails loudly.
 _GBT = (882589.65, -4924872.32, 3943729.348)
 _AO = (2390490.0, -5564764.0, 1994727.0)
 _VLA = (-1601192.0, -5041981.4, 3554871.4)
@@ -512,31 +516,164 @@ _PARKES = (-4554231.5, 2816759.1, -3454036.3)
 _JODRELL = (3822626.04, -154105.65, 5086486.04)
 _NANCAY = (4324165.81, 165927.11, 4670132.83)
 _EFFELSBERG = (4033949.5, 486989.4, 4900430.8)
+_WSRT = (3828445.659, 445223.600, 5064921.568)
+_GMRT = (1656342.30, 5797947.77, 2073243.16)
+_MEERKAT = (5109360.133, 2006852.586, -3238948.127)
+_LOFAR = (3826577.462, 461022.624, 5064892.526)
+_SRT = (4865182.766, 791922.689, 4035137.174)
+_FAST = (-1668557.0, 5506838.0, 2744934.0)
+_CHIME = (-2059166.3, -3621302.9, 4814304.1)
 
 OBSERVATORIES = {
-    "1": _GBT, "gbt": _GBT,
+    "1": _GBT, "gbt": _GBT, "gb": _GBT,
     "3": _AO, "ao": _AO, "arecibo": _AO,
     "6": _VLA, "vla": _VLA,
     "7": _PARKES, "pks": _PARKES, "parkes": _PARKES,
     "8": _JODRELL, "jb": _JODRELL, "jodrell": _JODRELL,
-    "f": _NANCAY, "ncy": _NANCAY, "nancay": _NANCAY,
+    "f": _NANCAY, "ncy": _NANCAY, "nancay": _NANCAY, "ncyobs": _NANCAY,
     "g": _EFFELSBERG, "eff": _EFFELSBERG, "effelsberg": _EFFELSBERG,
+    "i": _WSRT, "wsrt": _WSRT, "we": _WSRT,
+    "r": _GMRT, "gmrt": _GMRT,
+    "m": _MEERKAT, "meerkat": _MEERKAT, "mk": _MEERKAT,
+    "t": _LOFAR, "lofar": _LOFAR,
+    "z": _SRT, "srt": _SRT, "sardinia": _SRT,
+    "fast": _FAST,
+    "chime": _CHIME,
     "coe": (0.0, 0.0, 0.0), "geocenter": (0.0, 0.0, 0.0),
 }
 
 BARYCENTRIC_SITES = frozenset({"@", "0", "bat", "ssb"})
 
+# user-registered sites (register_observatory / load_tempo_obsys) checked
+# after the built-in table, never shadowing it
+_USER_OBSERVATORIES = {}
+
+
+def register_observatory(name, xyz_m, *, aliases=()):
+    """Register an observatory by ITRF geocentric ``(x, y, z)`` meters.
+
+    The TEMPO-parity escape hatch for the site codes this module does not
+    bake in (PINT/TEMPO resolve every obsys.dat entry; reference path:
+    psrsigsim/io/psrfits.py:116-181 via PINT).  Names/aliases are
+    case-insensitive.  See also :func:`load_tempo_obsys` to ingest a
+    whole TEMPO ``obsys.dat``.
+    """
+    xyz = np.asarray(xyz_m, np.float64).reshape(3)
+    if not np.all(np.isfinite(xyz)):
+        raise ValueError(f"non-finite ITRF coordinates for {name!r}: {xyz}")
+    r = float(np.linalg.norm(xyz))
+    if not (0.0 <= r < 7e6):
+        raise ValueError(
+            f"implausible ITRF radius {r:.0f} m for {name!r} (expected "
+            "geocentric meters, < 7000 km)")
+    for key in (name, *aliases):
+        _USER_OBSERVATORIES[str(key).strip().lower()] = tuple(xyz)
+
+
+def load_tempo_obsys(path):
+    """Ingest a TEMPO ``obsys.dat`` site table.
+
+    Line format (TEMPO convention): three coordinates, an OPTIONAL
+    geodetic flag as the 4th field (``1`` = geodetic, blank/``0`` =
+    ITRF XYZ meters), then the site name (may contain spaces) and 1-2
+    trailing short code fields.  Geodetic coordinates are ``ddmmss.ss``
+    latitude, ``ddmmss.ss`` WEST-positive longitude, and elevation in
+    meters, converted on a GRS80 ellipsoid.  Registers every parsed site
+    (name with spaces joined by ``_``, plus the code fields) via
+    :func:`register_observatory`; returns the number of sites loaded.
+    Lines that do not parse are skipped — TEMPO's own reader is just as
+    forgiving.
+    """
+    n = 0
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip()
+            if not line or line.lstrip().startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) < 4:
+                continue
+            try:
+                c1, c2, c3 = (float(parts[0]), float(parts[1]),
+                              float(parts[2]))
+            except ValueError:
+                continue
+            rest = parts[3:]
+            # the geodetic flag, when present, is the 4th FIELD — never
+            # part of the trailing code fields (the GBT line ends in the
+            # site number "1", which must not flip it to geodetic)
+            geodetic = rest[0] == "1"
+            if rest[0] in ("0", "1"):
+                rest = rest[1:]
+            if not rest:
+                continue
+            # trailing 1-2 short tokens are TEMPO code fields; the rest
+            # is the (possibly multi-word) site name
+            aliases = []
+            while len(rest) > 1 and len(rest[-1]) <= 3 and len(aliases) < 2:
+                aliases.append(rest.pop())
+            name = "_".join(rest)
+            if geodetic:
+                def dms(v):
+                    sign = -1.0 if v < 0 else 1.0
+                    v = abs(v)
+                    d = int(v // 10000)
+                    m = int((v - d * 10000) // 100)
+                    s = v - d * 10000 - m * 100
+                    return sign * (d + m / 60.0 + s / 3600.0)
+
+                lat = np.radians(dms(c1))
+                lon = np.radians(-dms(c2))  # TEMPO stores WEST longitude
+                elev = c3
+                a, finv = 6378137.0, 298.257222101  # GRS80
+                e2 = (2.0 - 1.0 / finv) / finv
+                N = a / np.sqrt(1.0 - e2 * np.sin(lat) ** 2)
+                xyz = ((N + elev) * np.cos(lat) * np.cos(lon),
+                       (N + elev) * np.cos(lat) * np.sin(lon),
+                       (N * (1.0 - e2) + elev) * np.sin(lat))
+            else:
+                xyz = (c1, c2, c3)
+            try:
+                register_observatory(name, xyz, aliases=aliases)
+                n += 1
+            except ValueError:
+                continue
+    return n
+
 
 def observatory_itrf(site):
-    """ITRF xyz (meters) for a TEMPO site code / name."""
+    """ITRF xyz (meters) for a TEMPO site code / name, a registered site,
+    or explicit coordinates.
+
+    Explicit forms accepted anywhere a site is (par TZRSITE strings
+    excepted — those are codes by format): a 3-sequence ``(x, y, z)`` in
+    meters, or a string ``"xyz:X,Y,Z"``.
+    """
+    if not isinstance(site, str) and np.ndim(site) == 1 and len(site) == 3:
+        return np.asarray(site, np.float64)
     key = str(site).strip().lower()
+    if key.startswith("xyz:"):
+        try:
+            return np.asarray([float(v) for v in key[4:].split(",")],
+                              np.float64).reshape(3)
+        except ValueError:
+            raise UnknownObservatoryError(
+                f"malformed explicit site {site!r}; expected "
+                "'xyz:X,Y,Z' in meters") from None
     try:
         return np.asarray(OBSERVATORIES[key], np.float64)
+    except KeyError:
+        pass
+    try:
+        return np.asarray(_USER_OBSERVATORIES[key], np.float64)
     except KeyError:
         raise UnknownObservatoryError(
             f"no ITRF coordinates for site code {site!r}; known codes: "
             f"{sorted(OBSERVATORIES)} plus barycentric "
-            f"{sorted(BARYCENTRIC_SITES)}") from None
+            f"{sorted(BARYCENTRIC_SITES)}. Register it with "
+            f"psrsigsim_tpu.io.ephem.register_observatory(name, (x, y, z)) "
+            f"or load a TEMPO table via load_tempo_obsys(path), or pass "
+            f"'xyz:X,Y,Z'.") from None
 
 
 def observatory_ssb(mjd_utc, site):

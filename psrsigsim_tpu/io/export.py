@@ -209,13 +209,27 @@ def _array_sha(arr):
     ).hexdigest()
 
 
+def _template_sha(tmpl):
+    """Content hash of a template: each HDU's serialized header cards and
+    raw data bytes — NOT pickle bytes, which vary across numpy/Python
+    versions and construction details and would spuriously reject a
+    legitimate cross-environment resume (advisor round 3)."""
+    h = hashlib.sha256()
+    for hdu in tmpl.hdus:
+        h.update(hdu.header.serialize())
+        if hdu.data is not None:
+            arr = np.ascontiguousarray(hdu.data)
+            h.update(str(arr.dtype.descr).encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def _manifest_fingerprint(n_obs, seed, dms, noise_norms, tmpl, parfile,
                           MJD_start, ref_MJD):
-    # the template is fingerprinted by CONTENT (of the parsed FitsFile),
-    # so str-path and FitsFile callers of the same file agree and a
-    # swapped template is caught on resume
-    tmpl_sha = hashlib.sha256(
-        pickle.dumps(tmpl, protocol=4)).hexdigest()
+    # the template is fingerprinted by CONTENT, so str-path and FitsFile
+    # callers of the same file agree and a swapped template is caught on
+    # resume
+    tmpl_sha = _template_sha(tmpl)
     return {
         "n_obs": int(n_obs),
         "seed": int(seed),

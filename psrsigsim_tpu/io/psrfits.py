@@ -172,6 +172,12 @@ class PSRFITS(BaseFile):
         primary_dict["OBSBW"] = self.obsbw.value
         primary_dict["CHAN_DM"] = (signal.dm.value if signal.dm is not None
                                    else 0.0)
+        # provenance: polycos in this file come from the built-in analytic
+        # ephemeris (truncated VSOP87 + Standish elements, io/ephem.py) —
+        # NOT a JPL development ephemeris.  Downstream tools comparing
+        # against their own DE-based predictors should expect the few-ms
+        # absolute phase offset documented in io/ephem.py (advisor r3).
+        primary_dict["EPHEM"] = "ANALYTIC-VSOP87"
         primary_dict["STT_IMJD"] = int(next_MJD)
         primary_dict["STT_SMJD"] = int(next_seconds)
         primary_dict["STT_OFFS"] = np.double(next_frac_sec)

@@ -127,6 +127,28 @@ def check_model_supported(params, parfile="<par>"):
     binary = str(params.get("BINARY", "")).strip().upper()
     if binary and binary not in _BINARY_OK:
         bad.append(f"BINARY={binary}")
+    if binary == "ELL1H":
+        # orthometric Shapiro needs two of (H3, H4/STIG): an H3-only par
+        # cannot separate the companion mass from the inclination, and
+        # silently dropping the Shapiro delay (sini=0) is a us-level
+        # systematic (advisor round 3). PINT/TEMPO fit such pars with an
+        # H3-only harmonic model we do not implement.
+        h3 = params.get("H3", 0.0)
+        if isinstance(h3, (float, np.floating)) and h3 != 0.0:
+            has_stig = any(
+                isinstance(params.get(k), (float, np.floating))
+                and params[k] != 0.0 for k in ("STIG", "VARSIGMA", "H4"))
+            if not has_stig:
+                bad.append("H3 (without STIG/H4)")
+    if binary in ("ELL1", "ELL1H"):
+        # EPS1DOT/EPS2DOT map onto EDOT/OMDOT (see _init_binary), which
+        # needs a defined eccentricity direction
+        dots = [k for k in ("EPS1DOT", "EPS2DOT")
+                if isinstance(params.get(k), (float, np.floating))
+                and params[k] != 0.0]
+        if dots and float(np.hypot(params.get("EPS1", 0.0) or 0.0,
+                                   params.get("EPS2", 0.0) or 0.0)) == 0.0:
+            bad.extend(dots)
     if not binary:
         # orbital parameters without a BINARY model would be silently
         # dropped — reject them instead
@@ -135,8 +157,13 @@ def check_model_supported(params, parfile="<par>"):
                    and params[k] != 0.0]
         bad.extend(orphans)
     site = str(params.get("TZRSITE", "@")).strip().lower()
-    if site not in ephem.BARYCENTRIC_SITES and site not in ephem.OBSERVATORIES:
-        bad.append(f"TZRSITE={params['TZRSITE']}")
+    if site not in ephem.BARYCENTRIC_SITES:
+        try:
+            # resolves built-ins, register_observatory/load_tempo_obsys
+            # entries, and explicit "xyz:..." forms alike
+            ephem.observatory_itrf(site)
+        except ephem.UnknownObservatoryError:
+            bad.append(f"TZRSITE={params['TZRSITE']}")
     if bad:
         raise UnsupportedTimingModelError(
             f"par file {parfile} contains timing-model terms this model "
@@ -310,6 +337,8 @@ class TimingModel:
             self.pb = 1.0 / (float(p["FB0"]) * _SEC_PER_DAY)
         else:
             raise ValueError(f"binary model {b} without PB/FB0")
+        self._eps_edot = 0.0
+        self._eps_omdot = 0.0
         if b in ("ELL1", "ELL1H"):
             eps1 = float(p.get("EPS1", 0.0))
             eps2 = float(p.get("EPS2", 0.0))
@@ -319,6 +348,20 @@ class TimingModel:
             # T0 (periastron) = TASC + (omega / 2 pi) * PB — exact
             # reparameterization of the same Keplerian orbit
             self.t0 = tasc + np.longdouble(self.om0 / (2 * np.pi) * self.pb)
+            # EPS1DOT/EPS2DOT: linear Laplace-parameter drift is exactly a
+            # joint (EDOT, OMDOT) drift to first order —
+            # e_dot = (e1 e1dot + e2 e2dot)/e, om_dot = (e1dot e2 - e1 e2dot)/e^2
+            e1d = float(p.get("EPS1DOT", 0.0))
+            e2d = float(p.get("EPS2DOT", 0.0))
+            # TEMPO legacy 1e-12 unit heuristic, as for PBDOT/EDOT below
+            if abs(e1d) > 1e-7:
+                e1d *= 1e-12
+            if abs(e2d) > 1e-7:
+                e2d *= 1e-12
+            if (e1d or e2d) and self.ecc > 0.0:
+                self._eps_edot = (eps1 * e1d + eps2 * e2d) / self.ecc  # 1/s
+                self._eps_omdot = ((e1d * eps2 - eps1 * e2d)
+                                   / self.ecc**2)  # rad/s
         else:
             self.ecc = float(p.get("ECC", p.get("E", 0.0)))
             self.om0 = float(p.get("OM", 0.0)) * _DEG
@@ -334,9 +377,10 @@ class TimingModel:
             return v * 1e-12 if abs(v) > 1e-7 else v
 
         self.pbdot = _dot("PBDOT")
-        self.omdot = float(p.get("OMDOT", 0.0)) * _DEG / 365.25  # rad/day
+        self.omdot = (float(p.get("OMDOT", 0.0)) * _DEG / 365.25
+                      + self._eps_omdot * _SEC_PER_DAY)  # rad/day
         self.xdot = _dot("XDOT", "A1DOT")  # lt-s/s
-        self.edot = _dot("EDOT")  # 1/s
+        self.edot = _dot("EDOT") + self._eps_edot  # 1/s
         self.gamma = float(p.get("GAMMA", 0.0))  # s
         # Shapiro parameterization: SINI/M2 (BT/DD/DDK via KIN), or
         # DDS SHAPMAX, or ELL1H H3/STIG orthometric
@@ -347,7 +391,7 @@ class TimingModel:
             self.sini = 1.0 - float(np.exp(-float(p["SHAPMAX"])))
         elif b == "ELL1H":
             h3 = float(p.get("H3", 0.0))
-            stig = float(p.get("STIG", 0.0))
+            stig = float(p.get("STIG", p.get("VARSIGMA", 0.0)))
             if stig <= 0.0 and h3 > 0.0 and float(p.get("H4", 0.0)) > 0.0:
                 # orthometric H3/H4 form (Freire & Wex 2010): stig = H4/H3
                 stig = float(p["H4"]) / h3
@@ -356,6 +400,16 @@ class TimingModel:
                 self.m2 = (h3 / stig**3) / ephem.SUN_T
             else:
                 self.sini = 0.0
+                if h3 != 0.0:
+                    # strict mode rejects this par upstream
+                    # (check_model_supported); reachable only via
+                    # strict=False, so warn rather than stay silent
+                    import warnings
+
+                    warnings.warn(
+                        f"{self.parfile}: ELL1H H3 without STIG/H4 — "
+                        "Shapiro delay dropped (sini=0); phases carry a "
+                        "us-level systematic", stacklevel=3)
         else:
             self.sini = float(p.get("SINI", 0.0))
 

@@ -215,10 +215,27 @@ class UserPortrait(PulsePortrait):
     def init_profiles(self, Nphase, Nchan=None):
         # like GaussPortrait's override: calc_profiles already divides by
         # the cached Amax, so no second normalization (which would reset
-        # _Amax to 1 and break later direct calc_profiles calls)
+        # _Amax to 1 and break later direct calc_profiles calls).
+        # The normalizer is pinned from a DENSE grid here (>= 2048 bins)
+        # so a later sparse-grid call can never cache a peak-missing Amax.
+        self._ensure_amax(max(int(Nphase), 2048), Nchan)
         ph = np.arange(Nphase) / Nphase
         self._profiles = self.calc_profiles(ph, Nchan=Nchan)
         self._max_profile = self._pick_max_profile(self._profiles)
+
+    def _ensure_amax(self, ndense, Nchan):
+        if hasattr(self, "_Amax"):
+            return
+        ph = np.arange(ndense) / ndense
+        n = 1 if Nchan is None else int(Nchan)
+        out = np.asarray(self._generator(ph, n), dtype=np.float64)
+        amax = float(np.amax(out))
+        if not (np.isfinite(amax) and amax > 0):
+            raise ValueError(
+                f"portrait_func's maximum over a {ndense}-bin phase grid "
+                f"is {amax}; the portrait must be positive somewhere to "
+                "define the normalization")
+        self._Amax = amax
 
     def calc_profiles(self, phases, Nchan=None):
         ph = np.asarray(phases, dtype=np.float64)
@@ -230,10 +247,13 @@ class UserPortrait(PulsePortrait):
             raise ValueError(
                 f"portrait_func returned shape {out.shape}, expected "
                 f"({n}, {len(ph)})")
-        # Amax cached on first evaluation and reused, like GaussPortrait
-        # (reference: portraits.py:177): synthesis paths call
-        # calc_profiles directly and rely on max ~ 1 for Smax/noise scales
-        self._Amax = self._Amax if hasattr(self, "_Amax") else np.amax(out)
+        # Amax cached once and reused, like GaussPortrait (reference:
+        # portraits.py:177): synthesis paths call calc_profiles directly
+        # and rely on max ~ 1 for Smax/noise scales.  Cached from a dense
+        # evaluation (never this call's possibly-sparse grid), and
+        # validated > 0 — an all-zero first draw must not pin Amax=0
+        # (advisor round 3).
+        self._ensure_amax(max(len(ph), 2048), Nchan)
         return out / self._Amax
 
 

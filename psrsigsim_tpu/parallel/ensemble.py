@@ -556,13 +556,8 @@ class MultiPulsarFoldEnsemble:
         self._bucket_data[bkey] = staged
         return staged
 
-    def run(self, epochs, seed=0, epoch_start=0, dm_offset=None):
+    def run(self, epochs, seed=0, epoch_start=0):
         """Simulate ``epochs`` observations of every pulsar.
-
-        ``dm_offset``: optional traced scalar added to every pulsar's DM —
-        the hook benchmarks use to chain successive calls into a
-        data-dependent sequence (bench.py ``_timed_calls``); pass a real
-        per-pulsar array via the workloads for physical DM changes.
 
         Returns a list (indexed like ``workloads``) of device arrays
         ``(epochs, Nchan, nsub*Nph)`` — shapes differ across buckets, which
@@ -595,13 +590,11 @@ class MultiPulsarFoldEnsemble:
             )(st["padded"], epoch_start + jnp.arange(epochs))
             keys = jax.device_put(keys, st["obs_sharding"])
 
-            dms = st["dms"]
-            if dm_offset is not None:
-                dms = dms + jnp.asarray(dm_offset, jnp.float32)
             prog = self._program(bkey, cfg0, epochs)
             out = prog(
-                keys, dms, st["norms"], st["nfolds"], st["draw_norms"],
-                st["dts"], st["profiles"], st["freqs"], st["chan_ids"],
+                keys, st["dms"], st["norms"], st["nfolds"],
+                st["draw_norms"], st["dts"], st["profiles"], st["freqs"],
+                st["chan_ids"],
             )
             for slot, idx in enumerate(members):
                 results[idx] = out[slot]

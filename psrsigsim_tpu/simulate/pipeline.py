@@ -25,7 +25,7 @@ import numpy as np
 
 from ..ops.shift import (coherent_dedisperse, coherent_dedisperse_os,
                          fourier_shift, plan_dedisperse_os)
-from ..ops.stats import blocked_chan_chi2, chan_chi2_field, chan_normal_field
+from ..ops.stats import chan_chi2_field, chan_normal_field
 from ..signal.state import SignalMeta
 from ..utils.constants import DM_K_MS_MHZ2
 from ..utils.rng import stage_key
@@ -227,7 +227,6 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
     return block + _chan_chi2(kn, chan_ids, noise_df, nsamp) * noise_norm
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def fold_pipeline_hetero(key, dm, noise_norm, nfold, draw_norm, profiles, cfg,
                          freqs=None, chan_ids=None, extra_delays_ms=None,
                          dt_ms=None):
@@ -249,10 +248,36 @@ def fold_pipeline_hetero(key, dm, noise_norm, nfold, draw_norm, profiles, cfg,
 
     Because ``nfold`` is traced, the chi-squared draws route through the
     Wilson-Hilferty transform unconditionally (ops/stats.py), valid for
-    ``nfold >= CHI2_WH_MIN_DF`` — :class:`MultiPulsarFoldEnsemble`
-    guards that at staging; direct callers must honor it too (or export
-    ``PSS_EXACT_CHI2=1``).
+    ``nfold >= CHI2_WH_MIN_DF``.  This wrapper enforces that domain
+    whenever ``nfold`` carries concrete values (every direct call, and
+    :class:`MultiPulsarFoldEnsemble`'s staging re-checks it for the
+    traced case); export ``PSS_EXACT_CHI2=1`` for the exact sampler with
+    small Nfold.
     """
+    import os
+
+    from ..ops.stats import CHI2_WH_MIN_DF
+
+    if not os.environ.get("PSS_EXACT_CHI2") and not isinstance(
+            nfold, jax.core.Tracer):
+        nf = np.asarray(nfold)
+        bad = nf[(nf != 1.0) & (nf < CHI2_WH_MIN_DF)]
+        if bad.size:
+            raise ValueError(
+                f"fold_pipeline_hetero traces its chi2 df, which draws "
+                f"through the Wilson-Hilferty approximation — only valid "
+                f"for Nfold >= {CHI2_WH_MIN_DF:.0f} (or exactly 1); got "
+                f"Nfold={float(bad.min()):g}. Use longer subintegrations "
+                f"or export PSS_EXACT_CHI2=1 for the exact gamma sampler."
+            )
+    return _fold_pipeline_hetero_jit(key, dm, noise_norm, nfold, draw_norm,
+                                     profiles, cfg, freqs, chan_ids,
+                                     extra_delays_ms, dt_ms)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _fold_pipeline_hetero_jit(key, dm, noise_norm, nfold, draw_norm, profiles,
+                              cfg, freqs, chan_ids, extra_delays_ms, dt_ms):
     return _fold_core(key, dm, noise_norm, nfold, draw_norm, nfold, profiles,
                       cfg, freqs, chan_ids, extra_delays_ms, dt_ms=dt_ms)
 

@@ -287,3 +287,133 @@ class TestBinaryAgainstIndependentOrbit:
         d_ret = m.binary_delay(t0_mjd + t_eval / 86400.0)
         d_check = m._binary_delay_at(t0_mjd + (t_eval - d_ret) / 86400.0)
         assert np.max(np.abs(d_ret - d_check)) < 1e-9
+
+
+class TestRound4Hardening:
+    """Round-4 items: ELL1H H3-only rejection, EPS1DOT/EPS2DOT support
+    (advisor round 3, severity medium), and the widened observatory
+    machinery (VERDICT round-3 'do this' #8)."""
+
+    BASE = ("PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\n"
+            "F0 100.0\nPEPOCH 56000\nDM 10.0\n"
+            "TZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n")
+
+    def test_ell1h_h3_only_rejected_strict(self, tmp_path):
+        par = tmp_path / "h3only.par"
+        par.write_text(self.BASE + "BINARY ELL1H\nPB 10.0\nA1 5.0\n"
+                       "TASC 56000\nEPS1 1e-4\nEPS2 2e-4\nH3 2e-7\n")
+        with pytest.raises(UnsupportedTimingModelError):
+            TimingModel.from_par(str(par))
+        # non-strict: builds, warns, and drops the Shapiro term
+        with pytest.warns(UserWarning, match="H3 without STIG"):
+            m = TimingModel.from_par(str(par), strict=False)
+        assert m.sini == 0.0
+
+    def test_ell1h_h3_stig_accepted(self, tmp_path):
+        par = tmp_path / "h3stig.par"
+        par.write_text(self.BASE + "BINARY ELL1H\nPB 10.0\nA1 5.0\n"
+                       "TASC 56000\nEPS1 1e-4\nEPS2 2e-4\n"
+                       "H3 2e-7\nSTIG 0.7\n")
+        m = TimingModel.from_par(str(par))
+        assert m.sini == pytest.approx(2 * 0.7 / (1 + 0.49))
+        assert m.m2 > 0
+
+    def test_eps_dots_map_to_edot_omdot(self, tmp_path):
+        eps1, eps2 = 1e-4, 2e-4
+        e1d, e2d = 3e-17, -2e-17  # 1/s, written directly (below heuristic)
+        par = tmp_path / "dots.par"
+        par.write_text(self.BASE + "BINARY ELL1\nPB 10.0\nA1 5.0\n"
+                       f"TASC 56000\nEPS1 {eps1}\nEPS2 {eps2}\n"
+                       f"EPS1DOT {e1d}\nEPS2DOT {e2d}\n")
+        m = TimingModel.from_par(str(par))
+        e = np.hypot(eps1, eps2)
+        assert m.edot == pytest.approx((eps1 * e1d + eps2 * e2d) / e,
+                                       rel=1e-12)
+        assert m.omdot == pytest.approx(
+            (e1d * eps2 - eps1 * e2d) / e**2 * 86400.0, rel=1e-12)
+        # and the delay actually drifts relative to the dot-free orbit
+        par0 = tmp_path / "nodots.par"
+        par0.write_text(self.BASE + "BINARY ELL1\nPB 10.0\nA1 5.0\n"
+                        f"TASC 56000\nEPS1 {eps1}\nEPS2 {eps2}\n")
+        m0 = TimingModel.from_par(str(par0))
+        t = np.asarray([56000.0 + 3650.0])
+        assert m.binary_delay(t) != pytest.approx(m0.binary_delay(t),
+                                                  abs=1e-12)
+
+    def test_eps_dots_without_ecc_rejected(self, tmp_path):
+        par = tmp_path / "dots0.par"
+        par.write_text(self.BASE + "BINARY ELL1\nPB 10.0\nA1 5.0\n"
+                       "TASC 56000\nEPS1 0.0\nEPS2 0.0\nEPS1DOT 3e-17\n")
+        with pytest.raises(UnsupportedTimingModelError):
+            TimingModel.from_par(str(par))
+
+
+class TestObservatoryRegistry:
+    def test_builtin_sites_resolve(self):
+        for code in ("1", "3", "7", "8", "f", "g", "i", "r", "m", "t", "z",
+                     "gbt", "meerkat", "fast", "chime", "wsrt", "gmrt"):
+            xyz = ephem.observatory_itrf(code)
+            assert xyz.shape == (3,)
+            r = np.linalg.norm(xyz)
+            assert 6.3e6 < r < 6.4e6, (code, r)
+
+    def test_register_and_resolve(self):
+        ephem.register_observatory("TestScope", (1e6, -2e6, 5.9e6),
+                                   aliases=("ts",))
+        np.testing.assert_allclose(ephem.observatory_itrf("ts"),
+                                   (1e6, -2e6, 5.9e6))
+        with pytest.raises(ValueError):
+            ephem.register_observatory("bad", (1e9, 0, 0))
+
+    def test_explicit_xyz_forms(self):
+        np.testing.assert_allclose(
+            ephem.observatory_itrf("xyz:1000.5,-2000,3000"),
+            (1000.5, -2000.0, 3000.0))
+        np.testing.assert_allclose(
+            ephem.observatory_itrf((10.0, 20.0, 30.0)), (10.0, 20.0, 30.0))
+        with pytest.raises(ephem.UnknownObservatoryError):
+            ephem.observatory_itrf("xyz:nope")
+
+    def test_unknown_still_fails_loudly(self):
+        with pytest.raises(ephem.UnknownObservatoryError):
+            ephem.observatory_itrf("definitely-not-a-site")
+
+    def test_load_tempo_obsys(self, tmp_path):
+        f = tmp_path / "obsys.dat"
+        f.write_text(
+            "# comment line\n"
+            "  882589.65   -4924872.32   3943729.348  GBT_COPY    0  GC\n"
+            "  382559.0    795024.0        800.0     GEOSITE     1  GS\n"
+            "garbage line that should be skipped\n"
+        )
+        n = ephem.load_tempo_obsys(str(f))
+        assert n == 2
+        np.testing.assert_allclose(ephem.observatory_itrf("gbt_copy"),
+                                   ephem.observatory_itrf("gbt"))
+        # geodetic line: 38 25' 59" N, 79 50' 24" W (TEMPO positive-west
+        # longitude), 800 m — the GBT's location, so the ddmmss conversion
+        # must land within a few km of the ITRF entry
+        xyz = ephem.observatory_itrf("geosite")
+        assert np.linalg.norm(xyz - ephem.observatory_itrf("gbt")) < 5e3
+
+
+class TestHeteroPipelineGuard:
+    def test_small_nfold_raises(self):
+        import jax
+        import jax.numpy as jnp
+
+        from psrsigsim_tpu.simulate import fold_pipeline_hetero
+        from psrsigsim_tpu.simulate.pipeline import FoldPipelineConfig
+        from psrsigsim_tpu.signal.state import SignalMeta
+
+        meta = SignalMeta(sigtype="FilterBankSignal", fcent_mhz=1400.0,
+                          bw_mhz=400.0, nchan=8, samprate_mhz=0.2048,
+                          fold=True)
+        cfg = FoldPipelineConfig(meta=meta, period_s=0.005, nsub=2, nph=64,
+                                 nfold=10.0, draw_norm=1.0, noise_df=10.0,
+                                 dt_ms=0.078125, clip_max=200.0)
+        profiles = jnp.ones((8, 64), jnp.float32)
+        with pytest.raises(ValueError, match="Wilson-Hilferty"):
+            fold_pipeline_hetero(
+                jax.random.key(0), jnp.float32(10.0), jnp.float32(0.1),
+                np.float32(10.0), jnp.float32(1.0), profiles, cfg)
