@@ -383,7 +383,7 @@ class TestObservatoryRegistry:
         f.write_text(
             "# comment line\n"
             "  882589.65   -4924872.32   3943729.348  GBT_COPY    0  GC\n"
-            "  382559.0    795024.0        800.0     GEOSITE     1  GS\n"
+            "  382559.0    795024.0        800.0   1   GEOSITE    GS\n"
             "garbage line that should be skipped\n"
         )
         n = ephem.load_tempo_obsys(str(f))
