@@ -147,3 +147,37 @@ class TestWriterPoolAndManifest:
             export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar,
                                     seed=1, chunk_size=2,
                                     noise_norms=nn * 2.0)
+
+
+class TestFastObsWriter:
+    def test_fast_path_bytes_equal_full_pipeline(self, ens, tmp_path):
+        """Every file the prototype writer emits must be byte-identical to
+        the full PSRFITS.save assembly for the same inputs."""
+        import jax
+
+        from psrsigsim_tpu.io.export import _write_obs, _write_obs_full
+
+        tmpl = FitsFile.read(TEMPLATE)
+        data, scl, offs = [np.asarray(jax.device_get(x))
+                           for x in ens.run_quantized(3, seed=11)]
+        pulsar = ens.pulsar
+        par = str(tmp_path / "fw.par")
+        from psrsigsim_tpu.utils import make_par
+
+        make_par(ens.signal_shell(), pulsar, outpar=par)
+        state = {"sig": ens.signal_shell(), "pulsar": pulsar,
+                 "template": tmpl, "parfile": par,
+                 "MJD_start": 56000.0, "ref_MJD": 56000.0}
+        fast_paths, full_paths = [], []
+        for j in range(3):
+            fp = str(tmp_path / f"fast{j}.fits")
+            _write_obs(state, fp, (data[j], scl[j], offs[j]), None)
+            fast_paths.append(fp)
+            gp = str(tmp_path / f"full{j}.fits")
+            _write_obs_full(dict(state), gp, (data[j], scl[j], offs[j]),
+                            None)
+            full_paths.append(gp)
+        # file 0 primes the prototype (full path); 1..2 take the fast path
+        for fp, gp in zip(fast_paths, full_paths):
+            with open(fp, "rb") as a, open(gp, "rb") as b:
+                assert a.read() == b.read(), fp
