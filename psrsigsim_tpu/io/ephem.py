@@ -3,8 +3,13 @@
 The reference delegates barycentering entirely to PINT (reference:
 io/psrfits.py:116-181, utils/utils.py:342-348), which reads a JPL
 development ephemeris (DE436 for the vendored NANOGrav par files).  No
-ephemeris files exist in this environment, so this module computes the
-observatory's solar-system-barycentric position from closed-form series:
+ephemeris files exist in this environment, so by default this module
+computes the observatory's solar-system-barycentric position from
+closed-form series (below).  Users who have a real JPL kernel can point
+``PSS_EPHEM=/path/to/de440s.bsp`` (or call :func:`set_ephemeris`) at it:
+``observatory_ssb`` then evaluates the kernel's Chebyshev polynomials
+(io/spk.py) — the same data path PINT/TEMPO use — and written PSRFITS
+headers record the kernel name in EPHEM.  Analytic-model details:
 
 - Earth heliocentric position: truncated VSOP87 series (the classical
   Meeus truncation) — ~arcsecond-level angular accuracy, which bounds the
@@ -40,7 +45,7 @@ __all__ = [
     "sun_ssb_offset",
     "observatory_itrf", "observatory_ssb", "solve_kepler",
     "OBSERVATORIES", "UnknownObservatoryError", "register_observatory",
-    "load_tempo_obsys",
+    "load_tempo_obsys", "set_ephemeris", "ephemeris_name",
 ]
 
 # -- constants ---------------------------------------------------------------
@@ -498,6 +503,58 @@ def _gmst_rad(mjd_ut):
     return np.mod(gmst_deg, 360.0) * _DEG
 
 
+# -- optional JPL ephemeris (SPK kernel) -------------------------------------
+
+_EPHEM_KERNEL = None   # loaded SPKKernel, or False = explicitly disabled
+_EPHEM_SOURCE = None   # path it was loaded from (for provenance)
+
+
+def set_ephemeris(path):
+    """Use a JPL SPK kernel (e.g. ``de440s.bsp``) for Earth/Sun
+    barycentric positions instead of the built-in analytic series.
+
+    Pass ``None`` to return to the analytic model.  Equivalent to
+    setting ``PSS_EPHEM=<path>`` before first use.  Absolute Roemer
+    delays then carry JPL-ephemeris accuracy, matching what the
+    reference gets from PINT (psrsigsim/io/psrfits.py:144-177).
+    """
+    global _EPHEM_KERNEL, _EPHEM_SOURCE
+    if path is None:
+        _EPHEM_KERNEL, _EPHEM_SOURCE = False, None
+        return None
+    from .spk import SPKKernel
+
+    _EPHEM_KERNEL = SPKKernel(path)
+    _EPHEM_SOURCE = str(path)
+    return _EPHEM_KERNEL
+
+
+def ephemeris_name():
+    """Provenance string for written headers: the loaded kernel's file
+    name, or the analytic model's tag."""
+    if _active_kernel() is not None:
+        import os as _os
+
+        return _os.path.splitext(_os.path.basename(_EPHEM_SOURCE))[0].upper()
+    return "ANALYTIC-VSOP87"
+
+
+def _active_kernel():
+    global _EPHEM_KERNEL, _EPHEM_SOURCE
+    if _EPHEM_KERNEL is None:
+        import os as _os
+
+        path = _os.environ.get("PSS_EPHEM")
+        if path:
+            from .spk import SPKKernel
+
+            _EPHEM_KERNEL = SPKKernel(path)
+            _EPHEM_SOURCE = path
+        else:
+            _EPHEM_KERNEL = False
+    return _EPHEM_KERNEL or None
+
+
 # -- observatories -----------------------------------------------------------
 
 class UnknownObservatoryError(ValueError):
@@ -690,15 +747,28 @@ def observatory_ssb(mjd_utc, site):
     mjd_utc = np.asarray(mjd_utc, np.float64)
     mjd_tdb = np.asarray(tdb_from_utc(mjd_utc), np.float64)
 
-    lon, lat, rad = earth_heliocentric(mjd_tdb)
-    lon = lon - _precession_lon(mjd_tdb)  # refer to J2000 equinox
-    cb = np.cos(lat)
-    earth_ecl = np.stack([rad * cb * np.cos(lon),
-                          rad * cb * np.sin(lon),
-                          rad * np.sin(lat)], axis=-1)
-    sun_ecl = sun_ssb_offset(mjd_tdb)  # already J2000 ecliptic
-    earth_ssb_equ = _ecl_to_equ(earth_ecl + sun_ecl)
-    sun_ssb_equ = _ecl_to_equ(sun_ecl)
+    kernel = _active_kernel()
+    if kernel is not None:
+        # JPL-ephemeris path (SPK kernel via PSS_EPHEM / set_ephemeris):
+        # positions in km, ICRF/J2000 equatorial — the same data path
+        # PINT/TEMPO take, closing the analytic model's few-ms absolute
+        # Roemer uncertainty
+        from . import spk as _spk
+
+        c_km_s = 299792.458
+        et = (mjd_tdb - 51544.5) * 86400.0
+        earth_lts = np.asarray(kernel.position(_spk.EARTH, et)) / c_km_s
+        sun_lts = np.asarray(kernel.position(_spk.SUN, et)) / c_km_s
+    else:
+        lon, lat, rad = earth_heliocentric(mjd_tdb)
+        lon = lon - _precession_lon(mjd_tdb)  # refer to J2000 equinox
+        cb = np.cos(lat)
+        earth_ecl = np.stack([rad * cb * np.cos(lon),
+                              rad * cb * np.sin(lon),
+                              rad * np.sin(lat)], axis=-1)
+        sun_ecl = sun_ssb_offset(mjd_tdb)  # already J2000 ecliptic
+        earth_lts = _ecl_to_equ(earth_ecl + sun_ecl) * AU_LTS
+        sun_lts = _ecl_to_equ(sun_ecl) * AU_LTS
 
     geo = observatory_itrf(site) / 299792458.0  # light-seconds
     if np.any(geo != 0.0):
@@ -713,4 +783,4 @@ def observatory_ssb(mjd_utc, site):
     else:
         obs_j2000 = np.zeros(np.shape(mjd_utc) + (3,))
 
-    return earth_ssb_equ * AU_LTS + obs_j2000, sun_ssb_equ * AU_LTS
+    return earth_lts + obs_j2000, sun_lts

@@ -123,20 +123,40 @@ class _FastObsWriter:
         pre, sub, post, pad = self._proto
         q_data, q_scl, q_offs = (np.asarray(a) for a in triple)
         arr = sub.data
-        npol = arr["DATA"].shape[1]
+        nsub, npol, nchan, nbin = arr["DATA"].shape
+        # same shape contract PSRFITS.save enforces (psrfits.py) — a
+        # wrong-shaped triple must raise, never broadcast silently
+        if q_data.shape != (nsub, nchan, nbin):
+            raise ValueError(
+                f"quantized data shape {q_data.shape} != "
+                f"{(nsub, nchan, nbin)}")
+        if q_scl.shape != (nsub, nchan) or q_offs.shape != (nsub, nchan):
+            raise ValueError(
+                f"quantized scl/offs shapes {q_scl.shape}/{q_offs.shape} "
+                f"!= {(nsub, nchan)}")
         # broadcast across pols exactly as PSRFITS.save's row assignment
         # does (numpy converts to the on-disk '>i2' in place)
         arr["DATA"][:] = q_data[:, None, :, :]
         arr["DAT_SCL"] = np.tile(q_scl, (1, npol))
         arr["DAT_OFFS"] = np.tile(q_offs, (1, npol))
         tmp = path + ".tmp"
+        bufs = [pre, arr.view(np.uint8).reshape(-1), pad, post]
+        total = sum(len(b) for b in bufs)
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
         try:
             # one gathered syscall; the array's raw buffer is the FITS
-            # payload already (on-disk big-endian layout from read)
-            os.writev(fd, [pre, arr.view(np.uint8).reshape(-1), pad, post])
-        finally:
+            # payload already (on-disk big-endian layout from read).
+            # A short write (disk full, RLIMIT_FSIZE) must NOT reach the
+            # rename — resume treats existing files as complete.
+            written = os.writev(fd, bufs)
+            if written != total:
+                raise IOError(
+                    f"short write to {tmp}: {written}/{total} bytes")
+        except BaseException:
             os.close(fd)
+            os.unlink(tmp)
+            raise
+        os.close(fd)
         os.replace(tmp, path)
 
     def _init_proto(self, path):

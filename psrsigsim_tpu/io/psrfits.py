@@ -172,12 +172,14 @@ class PSRFITS(BaseFile):
         primary_dict["OBSBW"] = self.obsbw.value
         primary_dict["CHAN_DM"] = (signal.dm.value if signal.dm is not None
                                    else 0.0)
-        # provenance: polycos in this file come from the built-in analytic
-        # ephemeris (truncated VSOP87 + Standish elements, io/ephem.py) —
-        # NOT a JPL development ephemeris.  Downstream tools comparing
-        # against their own DE-based predictors should expect the few-ms
-        # absolute phase offset documented in io/ephem.py (advisor r3).
-        primary_dict["EPHEM"] = "ANALYTIC-VSOP87"
+        # provenance: which solar-system ephemeris the polycos were built
+        # on — the loaded SPK kernel's name (PSS_EPHEM / set_ephemeris,
+        # JPL-grade absolute phase) or the built-in analytic model, whose
+        # few-ms absolute offset vs a JPL DE is documented in io/ephem.py
+        # (advisor r3).
+        from . import ephem as _ephem
+
+        primary_dict["EPHEM"] = _ephem.ephemeris_name()
         primary_dict["STT_IMJD"] = int(next_MJD)
         primary_dict["STT_SMJD"] = int(next_seconds)
         primary_dict["STT_OFFS"] = np.double(next_frac_sec)
