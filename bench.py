@@ -327,7 +327,7 @@ def time_cpu(cfg, profiles, noise_norm, freqs, dm, n_obs,
     return float(np.median(times))
 
 
-def _timed_width(call, w, reps=2):
+def _timed_width(call, w, reps=3):
     """Min wall time of ``call(w, seed)`` over ``reps`` fresh-seed runs,
     each closed with block + a tiny fetch (lazy-relay honesty)."""
     best = 1e9
@@ -340,7 +340,7 @@ def _timed_width(call, w, reps=2):
     return best
 
 
-def _timed_slope(call, w1, w2, reps=2):
+def _timed_slope(call, w1, w2, reps=3):
     """Steady-state seconds per unit of work via a two-width slope.
 
     Round-4 finding: on the remote-relay platforms this bench runs on,
@@ -461,7 +461,7 @@ def time_tpu_multipulsar(n_pulsars=128, epochs=8, epoch_chunk=2):
     # structure at two epoch counts cancels the large fixed per-call cost
     # (dispatch + per-bucket assembly + key staging) exactly
     # (_timed_slope); epoch counts stay multiples of epoch_chunk
-    e1, e2 = 2 * epoch_chunk, 2 * epoch_chunk + epochs
+    e1, e2 = 2 * epoch_chunk, 2 * epoch_chunk + 2 * epochs
     sec_per_epoch, _ = _timed_slope(
         lambda e, seed: ens.run(epochs=e, seed=seed), e1, e2)
     sync = _sync_probe(lambda it: ens.run(epochs=e1, seed=it + 200))
@@ -714,9 +714,10 @@ def time_io_encode(nchan=2048, nsub=20, nbin=2048):
 
     return {
         "native_available": True,
-        # what exports actually use: the measured load-time speed probe
+        # what exports actually use: the measured per-size speed probe
         # must agree, or the native path is auto-disabled (io/native)
-        "native_encode_selected": bool(native.encode_preferred()),
+        "native_encode_selected": bool(
+            native.encode_preferred(data.size)),
         "subint_encode_native_s": round(t_nat, 5),
         "subint_encode_python_s": round(t_py, 5),
         "subint_encode_speedup": round(t_py / t_nat, 2),

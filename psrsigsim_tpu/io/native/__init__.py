@@ -149,7 +149,7 @@ def encode_available():
     return _cast_ok
 
 
-_speed_ok = None
+_speed_ok = {}  # pow2 size bucket -> bool (native measured faster)
 
 
 def median3(fn):
@@ -168,25 +168,33 @@ def median3(fn):
     return ts[1]
 
 
-def encode_preferred():
-    """True when the native subint encode should actually be USED: it is
-    available, byte-identical (:func:`encode_available`), and MEASURED
-    faster than the numpy cast on this host.
+def encode_preferred(n_samples=None):
+    """True when the native subint encode should actually be USED for a
+    payload of ``n_samples`` float32 values: it is available,
+    byte-identical (:func:`encode_available`), and MEASURED faster than
+    the numpy cast on this host AT THAT SIZE.
 
     Round-3 driver record (BENCH_r03.json io_encode) caught the native
     path running 0.68x the numpy path on that machine while the gate was
-    compile-success only — so every export took the slow path on purpose.
-    Speed is now probed once per process on a representative block
-    (~8 MB, a few ms per side, median of 3) and the faster path wins;
-    ``PSS_NO_NATIVE=1`` still disables natively outright.
+    compile-success only — so every export took the slow path on
+    purpose.  Round 4 then found the winner is SIZE-dependent on some
+    hosts (numpy's cast wins small cache-resident blocks, the native
+    single pass wins large ones), so the probe runs once per pow2 size
+    bucket at the caller's payload size (clamped to [1 MB, 128 MB]; a
+    few ms per side, median of 3).  ``PSS_NO_NATIVE=1`` still disables
+    native outright.
     """
-    global _speed_ok
     if not encode_available():
         return False
+    n = 1 << 21 if n_samples is None else int(n_samples)
+    n = min(max(n, 1 << 18), 1 << 25)
+    bucket = n.bit_length()
     with _lock:
-        if _speed_ok is None:
+        if bucket not in _speed_ok:
             rng = np.random.default_rng(7)
-            nchan, nsub, nbin = 256, 4, 2048
+            nbin = 2048
+            nsub = max(1, min(8, (1 << bucket) // (256 * nbin)))
+            nchan = max(1, (1 << bucket) // (nsub * nbin))
             data = rng.normal(0, 50, (nchan, nsub * nbin)).astype(np.float32)
 
             def _numpy():
@@ -201,14 +209,14 @@ def encode_preferred():
             t_np = median3(_numpy)
             # require a real margin: a photo-finish should keep the
             # simpler numpy path
-            _speed_ok = bool(t_nat < 0.9 * t_np)
-    return _speed_ok
+            _speed_ok[bucket] = bool(t_nat < 0.9 * t_np)
+    return _speed_ok[bucket]
 
 
 def encode_speed_probe():
-    """The cached result of :func:`encode_preferred`'s measurement (None
-    when not probed yet) — surfaced for the bench report."""
-    return _speed_ok
+    """The cached size-bucket decisions of :func:`encode_preferred`
+    (empty when not probed yet) — surfaced for the bench report."""
+    return dict(_speed_ok)
 
 
 def encode_subints(data, nsub, nbin, npol=1):

@@ -293,7 +293,8 @@ class PSRFITS(BaseFile):
                 sim_sig.reshape(self.nchan, self.nsubint, row_len)
                 .transpose(1, 2, 0)[:, :, None, :]
             )
-        elif (native.encode_preferred() and self.npol == 1
+        elif (native.encode_preferred(
+                    np.asarray(signal.data).size) and self.npol == 1
                 and np.asarray(signal.data).dtype == np.float32
                 and np.asarray(signal.data).shape[0] == self.nchan):
             # C++ fast path: one pass over the float payload doing the
