@@ -188,7 +188,7 @@ def encode_preferred(n_samples=None):
         return False
     n = 1 << 21 if n_samples is None else int(n_samples)
     n = min(max(n, 1 << 18), 1 << 25)
-    bucket = n.bit_length()
+    bucket = (n - 1).bit_length()  # exact pow2 payloads probe at size n
     with _lock:
         if bucket not in _speed_ok:
             rng = np.random.default_rng(7)

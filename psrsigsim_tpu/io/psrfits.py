@@ -293,10 +293,12 @@ class PSRFITS(BaseFile):
                 sim_sig.reshape(self.nchan, self.nsubint, row_len)
                 .transpose(1, 2, 0)[:, :, None, :]
             )
-        elif (native.encode_preferred(
-                    np.asarray(signal.data).size) and self.npol == 1
+        elif (self.npol == 1
                 and np.asarray(signal.data).dtype == np.float32
-                and np.asarray(signal.data).shape[0] == self.nchan):
+                and np.asarray(signal.data).shape[0] == self.nchan
+                # the timed speed probe goes LAST: ineligible saves must
+                # not pay a per-size-bucket measurement they cannot use
+                and native.encode_preferred(np.asarray(signal.data).size)):
             # C++ fast path: one pass over the float payload doing the
             # truncation cast + byteswap + per-subint relayout; gated on a
             # measured speed probe, not just compile success (the round-3
