@@ -169,3 +169,71 @@ class TestRobustness:
                                     intlen=100.0, coeffs=c, frame=17)])
         with pytest.raises(ValueError, match="frame 17"):
             SPKKernel(path)
+
+
+class TestProvenanceCard:
+    def test_psrfits_ephem_card_names_kernel(self, tmp_path, ens_fixture=None):
+        """The written PRIMARY EPHEM card must name the active ephemeris
+        source (kernel name or ANALYTIC-VSOP87)."""
+        import jax
+
+        from psrsigsim_tpu.io import PSRFITS, FitsFile
+        from psrsigsim_tpu.parallel import FoldEnsemble, make_mesh
+        from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+        from psrsigsim_tpu.signal import FilterBankSignal
+        from psrsigsim_tpu.telescope import Backend, Receiver, Telescope
+        from psrsigsim_tpu.utils import make_par, make_quant
+
+        sig = FilterBankSignal(1400, 400, Nsubband=4, sample_rate=0.2048,
+                               sublen=0.5, fold=True)
+        psr = Pulsar(0.005, 0.05, GaussProfile(width=0.05), name="JE",
+                     seed=0)
+        sig._tobs = make_quant(1.0, "s")
+        sig._dm = make_quant(10.0, "pc/cm^3")
+        t = Telescope(100.0, area=5500.0, Tsys=35.0, name="T")
+        t.add_system("S", Receiver(fcent=1400, bandwidth=400, name="R"),
+                     Backend(samprate=12.5, name="B"))
+        import jax as _jax
+
+        e = FoldEnsemble(sig, psr, t, "S",
+                         mesh=make_mesh((1, 1),
+                                        devices=_jax.devices()[:1]))
+        data, scl, offs = [np.asarray(jax.device_get(x))
+                           for x in e.run_quantized(1, seed=0)]
+        par = str(tmp_path / "e.par")
+        make_par(e.signal_shell(), psr, outpar=par)
+        tmpl = str(tmp_path / ".." / ".." / "data" /
+                   "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+        import os
+
+        tmpl = os.path.join(os.path.dirname(__file__), "..", "data",
+                            "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+
+        def _write(path):
+            pf = PSRFITS(path=path, template=tmpl, obs_mode="PSR")
+            pf.get_signal_params(signal=e.signal_shell())
+            pf.save(e.signal_shell(), psr, parfile=par,
+                    quantized=(data[0], scl[0], offs[0]), verbose=False)
+
+        p1 = str(tmp_path / "ana.fits")
+        _write(p1)
+        assert str(FitsFile.read(p1)["PRIMARY"].header["EPHEM"]).strip() \
+            == "ANALYTIC-VSOP87"
+
+        kpath = str(tmp_path / "de999.bsp")
+        c = np.zeros((1, 3, 4))
+        t0 = (55990.0 - 51544.5) * 86400.0
+        write_spk_type2(kpath, [
+            dict(target=EARTH, center=SSB, init=t0, intlen=40.0 * 86400.0,
+                 coeffs=c),
+            dict(target=SUN, center=SSB, init=t0, intlen=40.0 * 86400.0,
+                 coeffs=c),
+        ])
+        try:
+            ephem.set_ephemeris(kpath)
+            p2 = str(tmp_path / "ker.fits")
+            _write(p2)
+            assert str(FitsFile.read(p2)["PRIMARY"].header["EPHEM"]
+                       ).strip() == "DE999"
+        finally:
+            ephem.set_ephemeris(None)
