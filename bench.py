@@ -816,12 +816,35 @@ def time_io_encode(nchan=2048, nsub=20, nbin=2048):
     }
 
 
+_REAL_STDOUT = sys.stdout
+
+
+def _checkpoint(detail):
+    """Print a PROVISIONAL result line after each completed config.
+
+    The driver records the LAST stdout line; the full bench is ~10-15
+    minutes of mostly compiles, so if the process is killed mid-run the
+    most recent provisional line still preserves every config measured
+    so far (the final line overwrites it with the complete result).
+    """
+    ens = detail.get("config5_ensemble", {})
+    line = {
+        "metric": "fold_ensemble_obs_per_sec",
+        "value": ens.get("tpu_obs_per_sec", 0.0),
+        "unit": "obs/s",
+        "vs_baseline": ens.get("speedup", 0.0),
+        "provisional": True,
+        "detail": detail,
+    }
+    print(json.dumps(line), file=_REAL_STDOUT, flush=True)
+
+
 def main():
     # keep stdout clean for the single JSON result line: the OO layer's
     # reference-parity warnings (sub-Nyquist sampling etc.) print to stdout
     with contextlib.redirect_stdout(sys.stderr):
         result = _main()
-    print(json.dumps(result))
+    print(json.dumps(result), file=_REAL_STDOUT, flush=True)
 
 
 def _main():
@@ -854,6 +877,7 @@ def _main():
         }
         log(f"{name}: cpu {t_cpu*1e3:.1f} ms/obs, device {t_tpu*1e3:.2f} ms/obs, "
             f"speedup {t_cpu/t_tpu:.1f}x")
+        _checkpoint(detail)
 
     # --- config 4: SEARCH-mode single-pulse stream with nulling ---------
     from psrsigsim_tpu.simulate import baseband_pipeline, single_pipeline
@@ -875,6 +899,7 @@ def _main():
     }
     log(f"config4_search_null: cpu {t_cpu4*1e3:.1f} ms/obs, device "
         f"{t_tpu4*1e3:.2f} ms/obs, speedup {t_cpu4/t_tpu4:.1f}x")
+    _checkpoint(detail)
 
     # --- config 3: baseband coherent dedispersion -----------------------
     cfg3, sprof3, nn3 = build_baseband_workload()
@@ -896,6 +921,7 @@ def _main():
     }
     log(f"config3_baseband: cpu {t_cpu3*1e3:.1f} ms/obs, device "
         f"{t_tpu3*1e3:.2f} ms/obs, speedup {t_cpu3/t_tpu3:.1f}x")
+    _checkpoint(detail)
 
     # --- config 5: Monte-Carlo ensemble ---------------------------------
     sim, cfg, profiles, noise_norm, freqs, dm = workloads["config1_fold64"]
@@ -916,12 +942,14 @@ def _main():
     }
     log(f"config5_ensemble: device {obs_per_sec:.1f} obs/s vs cpu "
         f"{cpu_obs_per_sec:.2f} obs/s -> {speedup:.1f}x")
+    _checkpoint(detail)
 
     # --- config 5b: heterogeneous 128-pulsar ensemble -------------------
     mp = time_tpu_multipulsar()
     detail["config5_multipulsar"] = mp
     log(f"config5_multipulsar: device {mp['tpu_obs_per_sec']:.1f} obs/s vs "
         f"cpu {1/mp['cpu_s_per_obs']:.2f} obs/s -> {mp['speedup']:.1f}x")
+    _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------
     exp = time_export_e2e()
@@ -931,6 +959,7 @@ def _main():
         f"{1/exp['cpu_s_per_obs']:.2f} obs/s -> {exp['speedup']:.1f}x; "
         f"direct-attach projection {exp['projected_direct_attach_obs_per_sec']:.0f} "
         f"obs/s ({exp['projected_direct_attach_speedup']:.0f}x)")
+    _checkpoint(detail)
 
     # --- host-side IO encode: native C++ vs pure Python -----------------
     detail["io_encode"] = time_io_encode()
