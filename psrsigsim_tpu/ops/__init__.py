@@ -16,6 +16,7 @@ from .shift import (
     fourier_shift,
 )
 from .stats import chi2_draw_norm, chi2_sample, normal_sample
+from .toa import fftfit_batch, fftfit_shift
 from .window import (
     fold_periods,
     offpulse_window,
@@ -35,6 +36,8 @@ __all__ = [
     "chi2_sample",
     "normal_sample",
     "chi2_draw_norm",
+    "fftfit_shift",
+    "fftfit_batch",
     "block_downsample",
     "rebin",
     "clip_cast",

@@ -1,0 +1,129 @@
+"""Template-matching TOA estimation (FFTFIT) — device-side and batched.
+
+The reference stops at writing simulated files; measuring pulse times of
+arrival from them requires external tools (PSRCHIVE ``pat``).  Since the
+north-star workload is Monte-Carlo TOA-uncertainty studies over 10k+
+observations (BASELINE.md config 5), the framework closes the loop: the
+classic frequency-domain template-matching estimator of Taylor (1992,
+Phil. Trans. R. Soc. A 341, 117 — "FFTFIT") as a jittable, vmappable op,
+so folded ensemble outputs become phase shifts + uncertainties without
+leaving the device.
+
+Model: ``profile(phi) ~ b * template(phi - tau) + offset + noise`` with
+``tau`` IN PHASE TURNS throughout this module (Taylor's paper works in
+bins; every formula below is his with ``tau_bins = N * tau_turns``
+substituted, which removes the N factors).  The maximum-likelihood
+``tau`` maximizes
+
+    C(tau) = sum_k |P_k| |T_k| cos(phase_k + 2 pi k tau)
+
+over the harmonic cross-spectrum (k = 1..K).  The implementation brackets
+the optimum with an upsampled circular cross-correlation (exact argmax on
+a 16x grid via zero-padded IFFT) and polishes with a fixed number of
+Newton steps on ``dC/dtau`` — fully static control flow, so the whole
+estimator jits and vmaps over (observation, channel) batches.
+
+Uncertainty (Taylor eq. A10 in turns):
+``sigma_tau^2 = sigma_n^2 / (2 b^2 sum_k (2 pi k)^2 |T_k|^2)``
+with the fitted amplitude ``b`` and the off-model residual variance
+``sigma_n^2`` (numerically calibrated: empirical-scatter / reported-sigma
+ratio ~1.00 over noise ensembles; tests/test_toa.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fftfit_shift", "fftfit_batch"]
+
+_UPSAMPLE = 16
+_NEWTON_STEPS = 6
+
+
+def _cross_objective_terms(prof, tmpl):
+    """Harmonic amplitudes/phases of the cross-spectrum (k = 1..K)."""
+    P = jnp.fft.rfft(prof)[1:]
+    T = jnp.fft.rfft(tmpl)[1:]
+    amp = jnp.abs(P) * jnp.abs(T)
+    phase = jnp.angle(P) - jnp.angle(T)
+    return P, T, amp, phase
+
+
+@partial(jax.jit, static_argnames=("nharm",))
+def fftfit_shift(profile, template, nharm=None):
+    """Phase shift of ``profile`` relative to ``template`` by FFTFIT.
+
+    Args:
+        profile: observed folded profile ``(Nbin,)`` (any real dtype).
+        template: noise-free template ``(Nbin,)`` on the same phase grid.
+        nharm: harmonics to use (static; default ``Nbin // 2``, i.e. all).
+
+    Returns:
+        ``(shift, sigma, scale)``:
+        ``shift`` in PHASE TURNS in [-0.5, 0.5) — multiply by the period
+        for a time offset (positive = profile arrives later);
+        ``sigma`` the Taylor (1992) template-matching uncertainty in
+        turns; ``scale`` the fitted template amplitude ``b``.
+    """
+    prof = jnp.asarray(profile, jnp.float32)
+    tmpl = jnp.asarray(template, jnp.float32)
+    n = prof.shape[-1]
+    kmax = n // 2 if nharm is None else min(int(nharm), n // 2)
+
+    P, T, amp, phase = _cross_objective_terms(prof, tmpl)
+    k = jnp.arange(1, n // 2 + 1, dtype=jnp.float32)
+    sel = (k <= kmax).astype(jnp.float32)
+    amp = amp * sel
+
+    # --- bracket: exact argmax of C on an upsampled circular grid -------
+    # C(tau) sampled at m/(U*n) is the zero-padded inverse FFT of the
+    # cross-spectrum (standard upsampled cross-correlation)
+    full = jnp.zeros(_UPSAMPLE * n // 2 + 1, jnp.complex64)
+    cross = (amp * jnp.exp(1j * phase)).astype(jnp.complex64)
+    full = full.at[1 : n // 2 + 1].set(cross)
+    corr = jnp.fft.irfft(full, n=_UPSAMPLE * n)
+    m0 = jnp.argmax(corr)
+    tau = m0.astype(jnp.float32) / (_UPSAMPLE * n)  # turns, in [0, 1)
+
+    # --- polish: Newton on dC/dtau (static step count) ------------------
+    w = 2.0 * jnp.pi * k
+
+    def step(tau, _):
+        ph = phase + w * tau
+        d1 = -jnp.sum(amp * w * jnp.sin(ph))
+        d2 = -jnp.sum(amp * w * w * jnp.cos(ph))
+        # guard: move only when the curvature says "maximum here"
+        delta = jnp.where(d2 < 0, d1 / d2, 0.0)
+        delta = jnp.clip(delta, -0.5 / n, 0.5 / n)
+        return tau - delta, None
+
+    tau, _ = jax.lax.scan(step, tau, None, length=_NEWTON_STEPS)
+    tau = jnp.mod(tau + 0.5, 1.0) - 0.5
+
+    # --- amplitude + uncertainty (Taylor 1992 appendix) -----------------
+    ph = phase + w * tau
+    t2 = jnp.sum(sel * jnp.abs(T) ** 2)
+    b = jnp.sum(amp * jnp.cos(ph)) / jnp.maximum(t2, 1e-30)
+    # off-model residual power per harmonic -> noise variance estimate
+    resid = (jnp.sum(sel * jnp.abs(P) ** 2) - b * b * t2)
+    nharm_eff = jnp.maximum(jnp.sum(sel), 1.0)
+    sigma2_n = jnp.maximum(resid, 0.0) / nharm_eff
+    curv = 2.0 * b * b * jnp.sum(sel * (w * jnp.abs(T)) ** 2)
+    sigma = jnp.sqrt(sigma2_n / jnp.maximum(curv, 1e-30))
+    return tau, sigma, b
+
+
+def fftfit_batch(profiles, template, nharm=None):
+    """Vectorized :func:`fftfit_shift` over any leading batch axes:
+    ``(..., Nbin)`` profiles against one template -> ``(...,)`` arrays
+    ``(shift, sigma, scale)``.  One fused device program — feed it
+    ``FoldEnsemble.folded_profiles`` output directly."""
+    profiles = jnp.asarray(profiles)
+    lead = profiles.shape[:-1]
+    flat = profiles.reshape((-1, profiles.shape[-1]))
+    fn = jax.vmap(lambda p: fftfit_shift(p, template, nharm=nharm))
+    s, e, b = fn(flat)
+    return s.reshape(lead), e.reshape(lead), b.reshape(lead)
