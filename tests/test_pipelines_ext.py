@@ -258,5 +258,10 @@ class TestComposedDelays:
             np.float32)
         sequential = np.asarray(
             fold_pipeline(k, 0.0, 0.0, shifted_prof, cfg))
+        # scale-aware: the two orderings differ only by f32/dfloat trig
+        # rounding, whose size tracks the signal scale (TPU trig rounds
+        # differently than CPU — absolute tolerances tuned on one
+        # platform fail the other)
+        scale = float(np.abs(sequential).max())
         np.testing.assert_allclose(combined, sequential, rtol=2e-5,
-                                   atol=2e-5)
+                                   atol=1e-6 * scale)
