@@ -69,12 +69,38 @@ _GOLD = int(np.int32(np.uint32(0x9E3779B9)))
 _TWO_PI = float(2.0 * np.pi)
 
 
+_PROBE_OK = None
+
+
 def hw_sampler_supported():
-    """True when the current default backend can run the Mosaic kernels."""
+    """True when the current default backend can run the Mosaic kernels.
+
+    Beyond the backend check, the first call actually compiles AND runs a
+    minimal kernel once (cached): a libtpu/Mosaic version that rejects
+    these kernels must degrade to the threefry path, not crash every
+    pipeline (and the benchmark record) at trace time.
+    """
+    global _PROBE_OK
     try:
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() != "tpu":
+            return False
     except Exception:  # pragma: no cover - uninitialized backend
         return False
+    if _PROBE_OK is None:
+        try:
+            out = hw_chan_field(jax.random.key(0), 0, 0.0, 0,
+                                mode="normal", nchan=8, length=RNG_BLOCK)
+            jax.block_until_ready(out)
+            _PROBE_OK = True
+        except Exception as err:  # pragma: no cover - env-dependent
+            import warnings
+
+            warnings.warn(
+                f"hardware-PRNG sampler unavailable on this TPU runtime "
+                f"({type(err).__name__}: {err}); falling back to the "
+                "threefry sampler", RuntimeWarning)
+            _PROBE_OK = False
+    return _PROBE_OK
 
 
 def _mix32(h):
