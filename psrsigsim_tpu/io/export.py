@@ -3,10 +3,14 @@
 Streams a sharded Monte-Carlo ensemble through the device-side int16
 quantizer (:meth:`FoldEnsemble.iter_chunks` with ``quantized=True`` —
 quarter-size bytes over the host link, real DAT_SCL/DAT_OFFS columns)
-into one PSRFITS file per observation, with user-visible progress and
-crash-safe resume.  Nothing like this exists in the reference — its
-save path handles one in-memory signal at a time
-(reference: io/psrfits.py:305-424, simulate/simulate.py:328-377).
+into PSRFITS files — one per observation, or ``obs_per_file``
+observations packed as consecutive SUBINT rows of each file (the
+multi-row subint-table shape real PUPPI/GUPPI archives use, which
+amortizes the per-file header/assembly cost that bounds one-obs-per-file
+exports) — with user-visible progress and crash-safe resume.  Nothing
+like this exists in the reference — its save path handles one in-memory
+signal at a time (reference: io/psrfits.py:305-424,
+simulate/simulate.py:328-377).
 
 Three stages overlap: the device computes chunk N+1 (``prefetch`` in
 :meth:`FoldEnsemble.iter_chunks`) while chunk N crosses the host link and
@@ -77,11 +81,25 @@ def _attach_chunk(shm_name, meta):
 
 
 def _write_obs_full(state, path, triple, dm):
-    """Write ONE observation's PSRFITS file through the full assembly
-    pipeline; atomic via .tmp + rename."""
+    """Write ONE output file (one observation, or ``obs_per_file``
+    observations packed as consecutive SUBINT rows) through the full
+    assembly pipeline; atomic via .tmp + rename.
+
+    The signal shell's subint geometry is resized to the triple: a packed
+    group of g observations IS a g-times-longer observation — same
+    subintegration cadence, OFFS_SUB continuing across the file, polyco
+    segments spanning the full duration (PSRFITS.save already fits one
+    segment per segLength minutes)."""
     sig = state["sig"]
     if dm is not None:
         sig._dm = make_quant(float(dm), "pc/cm^3")
+    nsub_rows = int(np.asarray(triple[0]).shape[0])
+    if nsub_rows != sig.nsub:
+        nbin = int(sig.nsamp // sig.nsub)   # invariant under resizing
+        sig._nsub = nsub_rows
+        sig._nsamp = nsub_rows * nbin
+        sig._tobs = make_quant(
+            nsub_rows * float(sig.sublen.to("s").value), "s")
     tmp = path + ".tmp"
     pfit = PSRFITS(path=tmp, template=state["template"], obs_mode="PSR")
     pfit.get_signal_params(signal=sig)
@@ -108,7 +126,10 @@ class _FastObsWriter:
 
     def __init__(self, state):
         self._state = state
-        self._proto = None
+        # keyed by the triple's (nsub_rows, nchan, nbin): packed exports
+        # end with one short final group whose geometry differs from the
+        # full groups', and each geometry needs its own prototype
+        self._protos = {}
 
     def write(self, path, triple, dm):
         if dm is not None:
@@ -116,11 +137,13 @@ class _FastObsWriter:
             # pipeline as the single source of truth for that rare path
             _write_obs_full(self._state, path, triple, dm)
             return
-        if self._proto is None:
+        shape = tuple(np.asarray(triple[0]).shape)
+        proto = self._protos.get(shape)
+        if proto is None:
             _write_obs_full(self._state, path, triple, dm)
-            self._init_proto(path)
+            self._protos[shape] = self._init_proto(path)
             return
-        pre, sub, post, pad = self._proto
+        pre, sub, post, pad = proto
         q_data, q_scl, q_offs = (np.asarray(a) for a in triple)
         arr = sub.data
         nsub, npol, nchan, nbin = arr["DATA"].shape
@@ -180,7 +203,7 @@ class _FastObsWriter:
         pre += sub.header.serialize()
         post = b"".join(_hdu_bytes(h) for h in f.hdus[i_sub + 1:])
         pad = b"\x00" * ((-sub.data.nbytes) % BLOCK)
-        self._proto = (pre, sub, post, pad)
+        return (pre, sub, post, pad)
 
 
 def _write_obs(state, path, triple, dm):
@@ -326,7 +349,7 @@ def _template_sha(tmpl):
 
 
 def _manifest_fingerprint(n_obs, seed, dms, noise_norms, tmpl, parfile,
-                          MJD_start, ref_MJD):
+                          MJD_start, ref_MJD, obs_per_file=1):
     # the template is fingerprinted by CONTENT, so str-path and FitsFile
     # callers of the same file agree and a swapped template is caught on
     # resume
@@ -340,6 +363,7 @@ def _manifest_fingerprint(n_obs, seed, dms, noise_norms, tmpl, parfile,
         "parfile": None if parfile is None else os.path.basename(str(parfile)),
         "MJD_start": float(MJD_start),
         "ref_MJD": float(ref_MJD),
+        "obs_per_file": int(obs_per_file),
     }
 
 
@@ -351,6 +375,9 @@ def _check_manifest(out_dir, fp, resume):
     if os.path.exists(path):
         with open(path) as f:
             old = json.load(f)
+        # manifests written before packing existed lack the key and mean
+        # one observation per file; a legitimate resume must not abort
+        old.setdefault("obs_per_file", 1)
         if resume and old != fp:
             diff = {k: (old.get(k), fp[k]) for k in fp if old.get(k) != fp[k]}
             raise ExportManifestError(
@@ -364,17 +391,70 @@ def _check_manifest(out_dir, fp, resume):
     os.replace(tmp, path)
 
 
+class _GroupPacker:
+    """Accumulate per-observation quantized triples into ``obs_per_file``
+    groups packed along the subint axis.
+
+    Chunk boundaries from :meth:`FoldEnsemble.iter_chunks` need not align
+    with file groups (chunk sizes round to the mesh's obs-shard count), so
+    groups fill incrementally from whatever slices arrive; a group's file
+    is written once its last observation lands.  Bounded memory: at most
+    the groups overlapping one chunk are buffered."""
+
+    def __init__(self, n_obs, obs_per_file):
+        self.n_obs = int(n_obs)
+        self.opf = int(obs_per_file)
+        self._buf = {}   # group index -> [per-obs triple COPIES or None]
+
+    def group_span(self, g):
+        first = g * self.opf
+        return first, min(first + self.opf, self.n_obs)
+
+    def add_chunk(self, start, triple):
+        """Feed one fetched chunk; yield ``(group_index, packed_triple)``
+        for every group the chunk completes.
+
+        A group wholly inside the chunk packs as a zero-copy reshape of
+        the chunk arrays; only boundary-straddling groups buffer — and
+        they buffer per-observation COPIES, so a pending group never pins
+        the whole previous chunk's arrays in memory."""
+        data, scl, offs = (np.asarray(a) for a in triple)
+        count = data.shape[0]
+        for g in range(start // self.opf, (start + count - 1) // self.opf + 1):
+            first, end = self.group_span(g)
+            size = end - first
+            lo = max(first, start)
+            hi = min(end, start + count)
+            if lo == first and hi == end and g not in self._buf:
+                sl = slice(lo - start, hi - start)
+                yield g, tuple(
+                    a[sl].reshape((size * a.shape[1],) + a.shape[2:])
+                    for a in (data, scl, offs))
+                continue
+            slot = self._buf.setdefault(g, [None] * size)
+            for i in range(lo, hi):
+                j = i - start
+                slot[i - first] = (data[j].copy(), scl[j].copy(),
+                                   offs[j].copy())
+            if all(p is not None for p in slot):
+                del self._buf[g]
+                parts = list(zip(*slot))
+                yield g, tuple(np.concatenate(p, axis=0) for p in parts)
+
+
 def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                             seed=0, dms=None, noise_norms=None,
                             chunk_size=256, progress=None, resume=True,
                             parfile=None, MJD_start=56000.0,
-                            ref_MJD=56000.0, writers=None):
+                            ref_MJD=56000.0, writers=None,
+                            obs_per_file=1):
     """Export ``n_obs`` ensemble observations as PSRFITS files.
 
     Args:
         ens: a configured :class:`~psrsigsim_tpu.parallel.FoldEnsemble`.
         n_obs: number of observations to export.
-        out_dir: output directory; files are ``obs_<index>.fits``.
+        out_dir: output directory; files are ``obs_<index>.fits``
+            (``obs_<first>-<last>.fits`` when ``obs_per_file > 1``).
         template: PSRFITS template path (read once) or a ``FitsFile``.
         pulsar: the :class:`Pulsar` the ensemble simulates (metadata +
             auto-par generation).
@@ -394,10 +474,31 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             ``if __name__ == "__main__"`` guard; otherwise the startup
             probe detects the broken pool and falls back to in-process
             writes with a warning.
+        obs_per_file: observations packed per output file as consecutive
+            SUBINT rows — the multi-row subint-table shape real
+            PUPPI/GUPPI archives use (cf. the reference's SUBINT assembly,
+            io/psrfits.py:305-424, and the vendored B1855+09 template).  A
+            packed file is byte-wise a single ``obs_per_file``-times-longer
+            observation: same cadence, OFFS_SUB continuing across the
+            file, polycos spanning the full duration; data, DAT_SCL and
+            DAT_OFFS per observation are identical to a one-file-per-obs
+            export of the same seed.  Per-file header overhead (the
+            measured host-write bound of one-obs files, BENCH_r04
+            ``host_write_s_per_obs``) is amortized ``obs_per_file``-fold.
+            Incompatible with per-observation ``dms`` (a file carries one
+            CHAN_DM/DM header).
 
     Returns:
-        list of the ``n_obs`` output file paths.
+        list of the output file paths (length ``ceil(n_obs/obs_per_file)``).
     """
+    obs_per_file = int(obs_per_file)
+    if obs_per_file < 1:
+        raise ValueError("obs_per_file must be >= 1")
+    if obs_per_file > 1 and dms is not None:
+        raise ValueError(
+            "obs_per_file > 1 packs observations into one file with a "
+            "single CHAN_DM/DM header; per-observation dms need "
+            "obs_per_file=1")
     os.makedirs(out_dir, exist_ok=True)
     tmpl = template if isinstance(template, FitsFile) else FitsFile.read(template)
     sig = ens.signal_shell()
@@ -408,25 +509,44 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         make_par(sig, pulsar, outpar=parfile)
 
     _check_manifest(out_dir, _manifest_fingerprint(
-        n_obs, seed, dms, noise_norms, tmpl, parfile, MJD_start, ref_MJD),
-        resume)
+        n_obs, seed, dms, noise_norms, tmpl, parfile, MJD_start, ref_MJD,
+        obs_per_file), resume)
 
     if writers is None:
         writers = min(8, os.cpu_count() or 1)
 
+    packer = _GroupPacker(n_obs, obs_per_file)
+    n_files = -(-n_obs // obs_per_file)
     width = max(5, len(str(n_obs - 1)))
-    paths = [os.path.join(out_dir, f"obs_{i:0{width}d}.fits")
-             for i in range(n_obs)]
+    if obs_per_file == 1:
+        paths = [os.path.join(out_dir, f"obs_{i:0{width}d}.fits")
+                 for i in range(n_obs)]
+    else:
+        paths = []
+        for g in range(n_files):
+            first, end = packer.group_span(g)
+            paths.append(os.path.join(
+                out_dir, f"obs_{first:0{width}d}-{end - 1:0{width}d}.fits"))
 
     # a finished file is the unit of resume; files are written to a temp
     # name and renamed on success, so existence implies completeness and
-    # whole chunks of finished work skip the device entirely
+    # whole chunks of finished work skip the device entirely (a chunk
+    # skips only when every file any of its observations feeds exists)
     skip = None
     if resume:
         def skip(start, count):
-            return all(os.path.exists(p) for p in paths[start:start + count])
+            g_lo = start // obs_per_file
+            g_hi = (start + count - 1) // obs_per_file
+            return all(os.path.exists(paths[g])
+                       for g in range(g_lo, g_hi + 1))
 
-    state = {"sig": sig, "pulsar": pulsar, "template": tmpl,
+    # the writer state carries a shallow COPY of the ensemble's signal
+    # shell: packed groups resize its subint geometry and per-obs DMs
+    # rebind its _dm, and neither mutation may leak into the live
+    # ensemble's signal object
+    import copy as _copy
+
+    state = {"sig": _copy.copy(sig), "pulsar": pulsar, "template": tmpl,
              "parfile": parfile, "MJD_start": MJD_start, "ref_MJD": ref_MJD}
     dms_np = None if dms is None else np.asarray(dms, np.float64)
 
@@ -442,7 +562,6 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                 "in-process writes", RuntimeWarning)
             pool = None
 
-    dm0 = sig._dm
     ok = False
     try:
         for start, (data, scl, offs) in ens.iter_chunks(
@@ -450,23 +569,47 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             noise_norms=noise_norms, quantized=True, progress=progress,
             skip_chunk=skip,
         ):
-            jobs = []
-            for j in range(data.shape[0]):
-                i = start + j
-                if resume and os.path.exists(paths[i]):
+            if obs_per_file == 1:
+                jobs = []
+                for j in range(data.shape[0]):
+                    i = start + j
+                    if resume and os.path.exists(paths[i]):
+                        continue
+                    jobs.append((j, paths[i],
+                                 None if dms_np is None else dms_np[i]))
+                if not jobs:
                     continue
-                jobs.append((j, paths[i],
-                             None if dms_np is None else dms_np[i]))
-            if not jobs:
+                if pool is not None:
+                    pool.submit_chunk((data, scl, offs), jobs)
+                else:
+                    for j, path, dm in jobs:
+                        _write_obs(state, path,
+                                   (data[j], scl[j], offs[j]), dm)
                 continue
-            if pool is not None:
-                pool.submit_chunk((data, scl, offs), jobs)
-            else:
-                for j, path, dm in jobs:
-                    _write_obs(state, path, (data[j], scl[j], offs[j]), dm)
+            todo = [(g, packed)
+                    for g, packed in packer.add_chunk(start, (data, scl, offs))
+                    if not (resume and os.path.exists(paths[g]))]
+            if not todo:
+                continue
+            if pool is None:
+                for g, packed in todo:
+                    _write_obs(state, paths[g], packed, None)
+                continue
+            # one SHM block + one job batch per (shape, chunk): all the
+            # groups a device chunk completes fan out across the pool
+            # together (the short final group has its own shape)
+            by_shape = {}
+            for g, packed in todo:
+                by_shape.setdefault(packed[0].shape, []).append((g, packed))
+            for items in by_shape.values():
+                stacked = tuple(
+                    np.stack([packed[i] for _, packed in items])
+                    for i in range(3))
+                jobs = [(k, paths[g], None)
+                        for k, (g, _) in enumerate(items)]
+                pool.submit_chunk(stacked, jobs)
         ok = True
     finally:
-        sig._dm = dm0
         if pool is not None:
             # on the failure path, clean up without masking the original
             # exception; on success, surface any worker error

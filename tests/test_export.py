@@ -96,6 +96,118 @@ class TestExport:
         assert not [p for p in os.listdir(out) if p.endswith(".tmp")]
 
 
+class TestPackedExport:
+    """obs_per_file > 1: many observations as consecutive SUBINT rows of
+    one file (the multi-row shape real PUPPI/GUPPI archives use)."""
+
+    def test_packed_files_geometry_and_offsets(self, ens, tmp_path):
+        out = str(tmp_path / "packed")
+        paths = export_ensemble_psrfits(ens, 5, out, TEMPLATE, ens.pulsar,
+                                        seed=5, chunk_size=2,
+                                        obs_per_file=2)
+        assert len(paths) == 3           # 2 + 2 + 1 observations
+        nsub = ens.cfg.nsub
+        sublen = float(ens.signal_shell().sublen.to("s").value)
+        for p, n_in_file in zip(paths, (2, 2, 1)):
+            sub = FitsFile.read(p)["SUBINT"]
+            rows = sub.data["DATA"].shape[0]
+            assert rows == n_in_file * nsub
+            # OFFS_SUB continues across the packed observations: the file
+            # is one n-times-longer observation at the same cadence
+            offs = np.asarray(sub.data["OFFS_SUB"], np.float64)
+            expect = sublen / 2.0 + np.arange(rows) * sublen
+            assert np.allclose(offs, expect)
+            assert np.allclose(np.asarray(sub.data["TSUBINT"]), sublen)
+
+    def test_packed_data_identical_to_single_obs_files(self, ens, tmp_path):
+        """Packing changes file layout only: every observation's DATA /
+        DAT_SCL / DAT_OFFS rows are bit-identical to the one-file-per-obs
+        export of the same seed."""
+        a = str(tmp_path / "single")
+        b = str(tmp_path / "packed")
+        pa = export_ensemble_psrfits(ens, 5, a, TEMPLATE, ens.pulsar,
+                                     seed=6, chunk_size=2)
+        pb = export_ensemble_psrfits(ens, 5, b, TEMPLATE, ens.pulsar,
+                                     seed=6, chunk_size=2, obs_per_file=2)
+        nsub = ens.cfg.nsub
+        for i in range(5):
+            g, k = divmod(i, 2)
+            sub_s = FitsFile.read(pa[i])["SUBINT"].data
+            sub_p = FitsFile.read(pb[g])["SUBINT"].data
+            sl = slice(k * nsub, (k + 1) * nsub)
+            for col in ("DATA", "DAT_SCL", "DAT_OFFS"):
+                assert np.array_equal(sub_s[col], sub_p[col][sl]), (i, col)
+
+    def test_packed_round_trip_load(self, ens, tmp_path):
+        """PSRFITS.load() of a packed file recovers the concatenated
+        dequantized observations."""
+        from psrsigsim_tpu.io import PSRFITS
+
+        out = str(tmp_path / "rt")
+        paths = export_ensemble_psrfits(ens, 4, out, TEMPLATE, ens.pulsar,
+                                        seed=7, chunk_size=4,
+                                        obs_per_file=4)
+        assert len(paths) == 1
+        S = PSRFITS(path=paths[0], template=paths[0]).load()
+        nsub, nbin = ens.cfg.nsub, ens.cfg.nph
+        assert S.nsub == 4 * nsub
+        assert S.data.shape == (ens.cfg.meta.nchan, 4 * nsub * nbin)
+        # dequantized physical values match the device triples
+        import jax
+
+        data, scl, offs = [np.asarray(jax.device_get(x))
+                           for x in ens.run_quantized(4, seed=7)]
+        phys = (data.astype(np.float64) * scl[..., None] + offs[..., None])
+        phys = phys.reshape(4 * nsub, ens.cfg.meta.nchan, nbin)
+        expect = phys.transpose(1, 0, 2).reshape(ens.cfg.meta.nchan, -1)
+        assert np.allclose(np.asarray(S.data), expect, rtol=1e-5, atol=1e-4)
+
+    def test_packed_chunk_misalignment_and_resume(self, ens, tmp_path):
+        """Group boundaries need not align with chunk boundaries, and a
+        deleted packed file regenerates byte-identically on resume."""
+        out = str(tmp_path / "mis")
+        paths = export_ensemble_psrfits(ens, 6, out, TEMPLATE, ens.pulsar,
+                                        seed=8, chunk_size=3,
+                                        obs_per_file=2)
+        assert len(paths) == 3
+        blobs = [open(p, "rb").read() for p in paths]
+        os.unlink(paths[1])
+        keep0 = os.path.getmtime(paths[0])
+        again = export_ensemble_psrfits(ens, 6, out, TEMPLATE, ens.pulsar,
+                                        seed=8, chunk_size=3,
+                                        obs_per_file=2)
+        assert again == paths
+        assert os.path.getmtime(paths[0]) == keep0
+        for p, blob in zip(paths, blobs):
+            assert open(p, "rb").read() == blob, p
+
+    def test_packed_pool_matches_serial(self, ens, tmp_path):
+        a = str(tmp_path / "ser")
+        b = str(tmp_path / "par")
+        pa = export_ensemble_psrfits(ens, 4, a, TEMPLATE, ens.pulsar,
+                                     seed=9, chunk_size=4, obs_per_file=2,
+                                     writers=1)
+        pb = export_ensemble_psrfits(ens, 4, b, TEMPLATE, ens.pulsar,
+                                     seed=9, chunk_size=4, obs_per_file=2,
+                                     writers=2)
+        for fa, fb in zip(pa, pb):
+            assert open(fa, "rb").read() == open(fb, "rb").read(), fa
+
+    def test_packed_rejects_per_obs_dms(self, ens, tmp_path):
+        with pytest.raises(ValueError, match="obs_per_file"):
+            export_ensemble_psrfits(
+                ens, 4, str(tmp_path / "x"), TEMPLATE, ens.pulsar,
+                dms=np.ones(4, np.float32), obs_per_file=2)
+
+    def test_packed_shell_not_mutated(self, ens, tmp_path):
+        sig = ens.signal_shell()
+        before = (sig.nsub, sig.nsamp, float(sig.tobs.to("s").value))
+        export_ensemble_psrfits(ens, 4, str(tmp_path / "nm"), TEMPLATE,
+                                ens.pulsar, seed=10, obs_per_file=4)
+        assert (sig.nsub, sig.nsamp,
+                float(sig.tobs.to("s").value)) == before
+
+
 class TestWriterPoolAndManifest:
     def test_parallel_writers_byte_identical_to_serial(self, ens, tmp_path):
         # the spawn-worker + shared-memory path must produce exactly the
