@@ -8,7 +8,7 @@ units) live in the model layer above.
 from .channelize import channelize_power
 from .convolve import convolve_profiles, fft_convolve_full
 from .interp import PchipCoeffs, pchip_eval, pchip_fit, pchip_slopes
-from .quantize import clip_cast, subint_dequantize, subint_quantize
+from .quantize import clip_cast, subint_dequantize, subint_quantize, swap16
 from .resample import block_downsample, rebin
 from .shift import (
     coherent_dedisperse,
@@ -43,6 +43,7 @@ __all__ = [
     "clip_cast",
     "subint_quantize",
     "subint_dequantize",
+    "swap16",
     "fft_convolve_full",
     "convolve_profiles",
     "fold_periods",

@@ -25,9 +25,10 @@ bit-identical for any mesh shape.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-__all__ = ["clip_cast", "subint_quantize", "subint_dequantize"]
+__all__ = ["clip_cast", "subint_quantize", "subint_dequantize", "swap16"]
 
 # int16 span used for DAT_SCL scaling: map [lo, hi] onto [-32767, 32767]
 # symmetrically (one code of headroom at the bottom, matching common
@@ -84,3 +85,19 @@ def subint_dequantize(data, scl, offs):
     """Inverse of :func:`subint_quantize`: ``(nsub, Nchan, nbin)`` int16 +
     per-row scale/offset back to float32 physical values."""
     return data.astype(jnp.float32) * scl[..., None] + offs[..., None]
+
+
+def swap16(data):
+    """Byte-swap int16 lanes ON DEVICE (elementwise shifts, fused into the
+    surrounding program by XLA).
+
+    PSRFITS DATA columns are big-endian ('>i2'); a little-endian host that
+    receives native int16 pays a byteswapping cast per observation while
+    refilling the SUBINT record array (~3x the cost of a same-dtype copy
+    at bulk-export sizes).  Swapping on device makes the fetched buffer
+    bit-correct for ``np.view('>i2')``: the host write path becomes pure
+    memcpy + writev.  An involution — applying it twice restores the
+    input."""
+    u = jax.lax.bitcast_convert_type(data, jnp.uint16)
+    sw = (u << jnp.uint16(8)) | (u >> jnp.uint16(8))
+    return jax.lax.bitcast_convert_type(sw, jnp.int16)

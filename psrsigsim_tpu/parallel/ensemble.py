@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.quantize import subint_quantize
+from ..ops.quantize import subint_quantize, swap16
 from ..simulate.pipeline import (
     build_fold_config,
     fold_pipeline,
@@ -128,24 +128,37 @@ class FoldEnsemble:
                 lambda b: subint_quantize(b, cfg.nsub, cfg.nph)
             )(blocks)
 
+        _quant_specs = dict(
+            mesh=mesh,
+            in_specs=(
+                P(OBS_AXIS),
+                P(OBS_AXIS),
+                P(OBS_AXIS),
+                P(CHAN_AXIS, None),
+                P(CHAN_AXIS),
+                P(CHAN_AXIS),
+            ),
+            out_specs=(
+                P(OBS_AXIS, None, CHAN_AXIS, None),
+                P(OBS_AXIS, None, CHAN_AXIS),
+                P(OBS_AXIS, None, CHAN_AXIS),
+            ),
+        )
         self._run_sharded_quantized = jax.jit(
-            shard_map(
-                _local_quantized,
-                mesh=mesh,
-                in_specs=(
-                    P(OBS_AXIS),
-                    P(OBS_AXIS),
-                    P(OBS_AXIS),
-                    P(CHAN_AXIS, None),
-                    P(CHAN_AXIS),
-                    P(CHAN_AXIS),
-                ),
-                out_specs=(
-                    P(OBS_AXIS, None, CHAN_AXIS, None),
-                    P(OBS_AXIS, None, CHAN_AXIS),
-                    P(OBS_AXIS, None, CHAN_AXIS),
-                ),
-            )
+            shard_map(_local_quantized, **_quant_specs)
+        )
+
+        def _local_quantized_be(keys, dms, norms, profiles, freqs, chan_ids):
+            # big-endian variant: byte-swap the int16 payload in-graph so
+            # the host PSRFITS writer refills its '>i2' record arrays with
+            # a same-dtype memcpy instead of a byteswapping cast (the
+            # measured bound of the packed bulk-export write machinery)
+            d, s, o = _local_quantized(keys, dms, norms, profiles, freqs,
+                                       chan_ids)
+            return swap16(d), s, o
+
+        self._run_sharded_quantized_be = jax.jit(
+            shard_map(_local_quantized_be, **_quant_specs)
         )
 
     @staticmethod
@@ -180,9 +193,12 @@ class FoldEnsemble:
         )
         return out[:n_obs] if pad else out
 
-    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None):
+    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None,
+                      byte_order="little"):
         """Simulate ``n_obs`` observations and quantize ON DEVICE to PSRFITS
         int16 subints (:func:`~psrsigsim_tpu.ops.subint_quantize`).
+        ``byte_order="big"`` additionally byte-swaps the payload in-graph
+        (see :meth:`iter_chunks`).
 
         Returns ``(data, scl, offs)``: ``(n_obs, nsub, Nchan, nbin)`` int16
         plus ``(n_obs, nsub, Nchan)`` float32 scale/offset columns, with
@@ -198,8 +214,12 @@ class FoldEnsemble:
         batch width the backend vectorizes over, which can flip rare codes
         by ±1 (see tests/test_quantize.py).
         """
+        if byte_order not in ("little", "big"):
+            raise ValueError("byte_order must be 'little' or 'big'")
         keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
-        data, scl, offs = self._run_sharded_quantized(
+        prog = (self._run_sharded_quantized_be if byte_order == "big"
+                else self._run_sharded_quantized)
+        data, scl, offs = prog(
             keys, dms, norms, self._profiles, self._freqs, self._chan_ids
         )
         if pad:
@@ -229,7 +249,7 @@ class FoldEnsemble:
 
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
                     noise_norms=None, quantized=False, progress=None,
-                    skip_chunk=None, prefetch=1):
+                    skip_chunk=None, prefetch=1, byte_order="little"):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -262,7 +282,16 @@ class FoldEnsemble:
         the end-to-end export off the serial dispatch->fetch->write path.
         Each in-flight chunk holds one extra output buffer on device;
         ``prefetch=0`` restores strictly serial behavior.
+
+        ``byte_order`` (quantized only): ``"big"`` byte-swaps the int16
+        payload IN-GRAPH (:func:`~psrsigsim_tpu.ops.swap16`) — the fetched
+        ``data`` then carries big-endian bit patterns in a native-int16
+        array, i.e. ``data.view('>i2')`` yields the true values.  Used by
+        the PSRFITS bulk exporter so host record-array refills are
+        same-dtype memcpys.
         """
+        if byte_order not in ("little", "big"):
+            raise ValueError("byte_order must be 'little' or 'big'")
         self._validate_per_obs(n_obs, dms, noise_norms)
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
@@ -281,7 +310,10 @@ class FoldEnsemble:
             keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
                                                     noise_norms)
             if quantized:
-                d, s, o = self._run_sharded_quantized(
+                prog = (self._run_sharded_quantized_be
+                        if byte_order == "big"
+                        else self._run_sharded_quantized)
+                d, s, o = prog(
                     keys, dms_c, norms_c, self._profiles, self._freqs,
                     self._chan_ids,
                 )

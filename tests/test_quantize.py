@@ -52,6 +52,17 @@ class TestQuantizeOps:
         np.testing.assert_array_equal(np.asarray(scl), 1.0)
         np.testing.assert_array_equal(np.asarray(offs), 5.0)
 
+    def test_swap16_involution_and_view_equivalence(self):
+        from psrsigsim_tpu.ops import swap16
+
+        rng = np.random.default_rng(3)
+        x = rng.integers(-32768, 32768, size=(5, 33), dtype=np.int16)
+        sw = np.asarray(swap16(jnp.asarray(x)))
+        # the swapped bit patterns ARE the values under big-endian view
+        np.testing.assert_array_equal(sw.view(">i2").astype(np.int16), x)
+        # involution
+        np.testing.assert_array_equal(np.asarray(swap16(jnp.asarray(sw))), x)
+
     def test_clip_cast_matches_reference_semantics(self):
         # reference: out[out > clip] = clip; np.array(out, dtype=int8)
         # (telescope/telescope.py:141-145) — truncation toward zero
@@ -93,6 +104,19 @@ class TestEnsembleQuantized:
         assert data.dtype == jnp.int16
         assert scl.shape == (3, nsub, nchan)
         assert offs.shape == (3, nsub, nchan)
+
+    def test_big_endian_path_matches_little(self):
+        # byte_order="big" must change bit patterns only: viewing the
+        # payload as '>i2' recovers exactly the little-endian values,
+        # and scl/offs are untouched
+        ens, _, _ = _ensemble()
+        d_le, s_le, o_le = ens.run_quantized(n_obs=2, seed=5)
+        d_be, s_be, o_be = ens.run_quantized(n_obs=2, seed=5,
+                                             byte_order="big")
+        np.testing.assert_array_equal(
+            np.asarray(d_be).view(">i2").astype(np.int16), np.asarray(d_le))
+        np.testing.assert_array_equal(np.asarray(s_be), np.asarray(s_le))
+        np.testing.assert_array_equal(np.asarray(o_be), np.asarray(o_le))
 
     def test_matches_float_pipeline(self):
         # quantizing the float ensemble output on host must reproduce the
