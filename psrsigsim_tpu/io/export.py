@@ -60,9 +60,20 @@ _worker_state = None  # per-process: dict set by _writer_init
 
 
 def _writer_init(payload):
-    """Spawn-worker initializer: unpickle the shared write context once."""
+    """Spawn-worker initializer: unpickle the shared write context once.
+
+    Spawn workers start with fresh module state: an ephemeris the parent
+    activated via ``ephem.set_ephemeris(path)`` (tutorial 8's API path)
+    would silently NOT apply to worker-written files — only the
+    ``PSS_EPHEM`` env var survives a spawn — so the parent's active
+    source rides along in the pickled state (advisor round 4)."""
     global _worker_state
     _worker_state = pickle.loads(payload)
+    src = _worker_state.get("ephemeris_source")
+    if src is not None:
+        from . import ephem
+
+        ephem.set_ephemeris(src)
 
 
 def _attach_chunk(shm_name, meta):
@@ -546,8 +557,13 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     # ensemble's signal object
     import copy as _copy
 
+    from . import ephem as _ephem
+
     state = {"sig": _copy.copy(sig), "pulsar": pulsar, "template": tmpl,
-             "parfile": parfile, "MJD_start": MJD_start, "ref_MJD": ref_MJD}
+             "parfile": parfile, "MJD_start": MJD_start, "ref_MJD": ref_MJD,
+             # workers must barycenter with the SAME ephemeris as the
+             # parent (see _writer_init); None = analytic/PSS_EPHEM
+             "ephemeris_source": _ephem._EPHEM_SOURCE}
     dms_np = None if dms is None else np.asarray(dms, np.float64)
 
     pool = None
@@ -567,8 +583,12 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         for start, (data, scl, offs) in ens.iter_chunks(
             n_obs, chunk_size=chunk_size, seed=seed, dms=dms,
             noise_norms=noise_norms, quantized=True, progress=progress,
-            skip_chunk=skip,
+            skip_chunk=skip, byte_order="big",
         ):
+            # the device already emitted big-endian bit patterns
+            # (ops.swap16): reinterpret, so every downstream record-array
+            # refill and PSRFITS.save cast is a same-dtype memcpy
+            data = np.asarray(data).view(">i2")
             if obs_per_file == 1:
                 jobs = []
                 for j in range(data.shape[0]):

@@ -209,6 +209,31 @@ class TestPackedExport:
 
 
 class TestWriterPoolAndManifest:
+    def test_pool_workers_honor_active_ephemeris(self, ens, tmp_path):
+        """A kernel activated via ephem.set_ephemeris in the PARENT must
+        reach spawn workers (advisor r4: only PSS_EPHEM, as an env var,
+        survives a spawn on its own) — every worker-written file's EPHEM
+        card names the kernel."""
+        import numpy as np
+
+        from psrsigsim_tpu.io import ephem
+        from psrsigsim_tpu.io.spk import SSB, SUN, write_spk_type2
+
+        kpath = str(tmp_path / "dtest9.bsp")
+        write_spk_type2(kpath, [dict(target=SUN, center=SSB, init=0.0,
+                                     intlen=1e9, coeffs=np.zeros((1, 3, 2)))])
+        out = str(tmp_path / "eph")
+        ephem.set_ephemeris(kpath)
+        try:
+            paths = export_ensemble_psrfits(ens, 3, out, TEMPLATE,
+                                            ens.pulsar, seed=12,
+                                            chunk_size=3, writers=2)
+        finally:
+            ephem.set_ephemeris(None)
+        for p in paths:
+            card = FitsFile.read(p)["PRIMARY"].header["EPHEM"]
+            assert str(card).strip().startswith("DTEST9"), p
+
     def test_parallel_writers_byte_identical_to_serial(self, ens, tmp_path):
         # the spawn-worker + shared-memory path must produce exactly the
         # files the in-process path does
