@@ -596,7 +596,10 @@ class PSRFITS(BaseFile):
         raise NotImplementedError()
 
     def to_psrfits(self):
-        return NotImplementedError()
+        # the reference RETURNS the exception instead of raising
+        # (io/psrfits.py:520) — a silent no-op for any caller not
+        # inspecting the return value; fixed + ledgered (DIVERGENCES #26)
+        raise NotImplementedError()
 
     def set_sky_info(self):
         raise NotImplementedError()

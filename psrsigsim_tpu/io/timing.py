@@ -20,7 +20,10 @@ arrival, like TEMPO/PINT.  Supported components:
 - binary: BT, DD, DDS, DDK, ELL1, ELL1H via an exact Kepler solve
   (ELL1 eccentric parameters are converted to e/omega/T0, which is the
   exact form of the same orbit; DDK's Kopeikin annual-orbital-parallax
-  corrections to x and omega are ~us-level and deliberately omitted).
+  corrections to x and omega are ~us-level and deliberately omitted);
+  ELL1H Shapiro from STIG/H4, or the H3-only third-harmonic form
+  (Freire & Wex 2010) when only H3 is given;
+- glitches: GLEP/GLPH/GLF0/GLF1/GLF2 plus the GLF0D/GLTD decaying term.
 
 Phase arithmetic is carried in numpy longdouble (80-bit on x86): with
 |phase| ~ 1e10 cycles over a NANOGrav span the representation error is
@@ -48,10 +51,11 @@ _PC_LTS = 3.0856775814913673e16 / 299792458.0  # parsec in light-seconds
 
 class UnsupportedTimingModelError(ValueError):
     """The par file carries timing-model terms this model cannot honor
-    (glitches, orbital-frequency series, TCB units, unknown binary models
-    or site codes).  The reference handles arbitrary models through PINT
-    (reference: io/psrfits.py:144-177); here unsupported terms must be
-    rejected loudly rather than silently ignored."""
+    (orbital-frequency series FB1+, TCB units, unknown binary models,
+    unknown glitch-family or site codes).  The reference handles arbitrary
+    models through PINT (reference: io/psrfits.py:144-177); here
+    unsupported terms must be rejected loudly rather than silently
+    ignored."""
 
 
 # multi-line flagged terms (noise/jump descriptors) collected as lists by
@@ -65,6 +69,7 @@ _BINARY_OK = frozenset({"BT", "DD", "DDS", "DDK", "ELL1", "ELL1H"})
 # high-precision epochs: parse as longdouble, not float64 (float64 MJD
 # quantizes at ~0.6 us -> ~1e-4 cycles of absolute phase for a MSP)
 _LONGDOUBLE_KEYS = frozenset({"TZRMJD", "PEPOCH", "T0", "TASC", "POSEPOCH"})
+_LONGDOUBLE_PREFIXES = ("GLEP_",)  # glitch epochs need the same precision
 
 
 def parse_par_full(parfile):
@@ -104,42 +109,43 @@ def _parse_value(key, val):
     if key in ("TZRSITE", "NSITE") or not _is_number(val):
         return val  # site codes are labels even when they look numeric
     txt = val.replace("D", "E").replace("d", "e")
-    if key in _LONGDOUBLE_KEYS:
+    if key in _LONGDOUBLE_KEYS or key.startswith(_LONGDOUBLE_PREFIXES):
         return np.longdouble(txt)
     return float(txt)
 
 
 def check_model_supported(params, parfile="<par>"):
     """Raise :class:`UnsupportedTimingModelError` for terms that would be
-    silently mispredicted: glitches, FB1+ orbital-frequency derivatives,
-    TCB units, unknown binary models, unknown observatory codes."""
+    silently mispredicted: FB1+ orbital-frequency derivatives, TCB units,
+    unknown binary models, unknown glitch-family terms, incomplete glitch
+    groups, unknown observatory codes."""
     bad = []
+    glitch_idx = set()
     for key, val in params.items():
         kb = key.rstrip("#")
-        if kb.startswith(("GLEP", "GLPH", "GLF0", "GLF1", "GLF2")):
-            bad.append(key)
+        m = re.match(r"^GL(EP|PH|F0D|F0|F1|F2|TD)_(\d+)$", kb)
+        if m:
+            # glitch terms are implemented (TimingModel._init_glitches);
+            # collect indices to cross-check completeness below
+            glitch_idx.add(m.group(2))
+        elif kb.startswith("GL"):
+            bad.append(key)  # unknown glitch-family term
         elif re.match(r"^FB[1-9]\d*$", kb):
             if isinstance(val, (float, np.floating)) and val != 0.0:
                 bad.append(key)
+    for idx in sorted(glitch_idx):
+        if f"GLEP_{idx}" not in params:
+            bad.append(f"GLF*_{idx} (without GLEP_{idx})")
+        f0d = params.get(f"GLF0D_{idx}", 0.0)
+        if (isinstance(f0d, (float, np.floating)) and f0d != 0.0
+                and not params.get(f"GLTD_{idx}", 0.0)):
+            bad.append(f"GLF0D_{idx} (without GLTD_{idx})")
     units = str(params.get("UNITS", "TDB")).upper()
     if units not in ("TDB", ""):
         bad.append(f"UNITS={units}")
     binary = str(params.get("BINARY", "")).strip().upper()
     if binary and binary not in _BINARY_OK:
         bad.append(f"BINARY={binary}")
-    if binary == "ELL1H":
-        # orthometric Shapiro needs two of (H3, H4/STIG): an H3-only par
-        # cannot separate the companion mass from the inclination, and
-        # silently dropping the Shapiro delay (sini=0) is a us-level
-        # systematic (advisor round 3). PINT/TEMPO fit such pars with an
-        # H3-only harmonic model we do not implement.
-        h3 = params.get("H3", 0.0)
-        if isinstance(h3, (float, np.floating)) and h3 != 0.0:
-            has_stig = any(
-                isinstance(params.get(k), (float, np.floating))
-                and params[k] != 0.0 for k in ("STIG", "VARSIGMA", "H4"))
-            if not has_stig:
-                bad.append("H3 (without STIG/H4)")
     if binary in ("ELL1", "ELL1H"):
         # EPS1DOT/EPS2DOT map onto EDOT/OMDOT (see _init_binary), which
         # needs a defined eccentricity direction
@@ -214,6 +220,7 @@ class TimingModel:
             raise ValueError(f"par file {parfile} has no F0")
         self.f_terms = fs
         self.pepoch = np.longdouble(p.get("PEPOCH", 56000.0))
+        self._init_glitches(p)
 
         # -- astrometry --------------------------------------------------
         self._init_direction(p)
@@ -285,6 +292,31 @@ class TimingModel:
             _MODEL_CACHE[key] = model
         return model
 
+    def _init_glitches(self, p):
+        """Collect GLEP_i/GLPH_i/GLF0_i/GLF1_i/GLF2_i/GLF0D_i/GLTD_i
+        glitch terms (TEMPO/PINT semantics: for t >= GLEP_i the phase
+        gains GLPH + GLF0*dt + GLF1*dt^2/2 + GLF2*dt^3/6 +
+        GLF0D*tau*(1 - exp(-dt/tau)), dt in seconds, tau = GLTD days).
+        The reference accepts these through PINT
+        (psrsigsim/io/psrfits.py:116-181); pre-round-5 builds rejected
+        them loudly (DIVERGENCES #17)."""
+        self.glitches = []
+        for key in p:
+            m = re.match(r"^GLEP_(\d+)$", key)
+            if not m:
+                continue
+            i = m.group(1)
+            self.glitches.append({
+                "ep": np.longdouble(p[key]),
+                "ph": float(p.get(f"GLPH_{i}", 0.0)),
+                "f0": float(p.get(f"GLF0_{i}", 0.0)),
+                "f1": float(p.get(f"GLF1_{i}", 0.0)),
+                "f2": float(p.get(f"GLF2_{i}", 0.0)),
+                "f0d": float(p.get(f"GLF0D_{i}", 0.0)),
+                "td_s": float(p.get(f"GLTD_{i}", 0.0)) * _SEC_PER_DAY,
+            })
+        self.glitches.sort(key=lambda g: g["ep"])
+
     def _init_direction(self, p):
         """Unit vector to the pulsar (equatorial J2000) with proper
         motion, from equatorial or ecliptic par coordinates."""
@@ -331,6 +363,7 @@ class TimingModel:
 
     def _init_binary(self, p):
         b = self.binary
+        self._h3_only = 0.0
         if "PB" in p:
             self.pb = float(p["PB"])  # days
         elif "FB0" in p:
@@ -398,18 +431,16 @@ class TimingModel:
             if stig > 0:
                 self.sini = 2.0 * stig / (1.0 + stig**2)
                 self.m2 = (h3 / stig**3) / ephem.SUN_T
-            else:
+            elif h3 != 0.0:
+                # H3-only orthometric model (Freire & Wex 2010 eq 19, the
+                # form PINT/TEMPO2 fit when only H3 is measurable): keep
+                # exactly the third harmonic of the Shapiro expansion,
+                # Delta_S3 = -(4/3) h3 sin(3 Phi) with Phi the orbital
+                # phase from the ascending node.  The k<3 harmonics are
+                # covariant with the Roemer parameters and the k>3 terms
+                # are O(h3*stig) — unmeasurable when only H3 fits.
+                self._h3_only = h3  # seconds
                 self.sini = 0.0
-                if h3 != 0.0:
-                    # strict mode rejects this par upstream
-                    # (check_model_supported); reachable only via
-                    # strict=False, so warn rather than stay silent
-                    import warnings
-
-                    warnings.warn(
-                        f"{self.parfile}: ELL1H H3 without STIG/H4 — "
-                        "Shapiro delay dropped (sini=0); phases carry a "
-                        "us-level systematic", stacklevel=3)
         else:
             self.sini = float(p.get("SINI", 0.0))
 
@@ -451,6 +482,12 @@ class TimingModel:
             arg = 1.0 - ecc * cE - self.sini * (so * (cE - ecc)
                                                 + sq * co * sE)
             delay = delay - 2.0 * r * np.log(np.maximum(arg, 1e-12))
+        elif self._h3_only:
+            # Freire & Wex 2010 eq 19: third harmonic of the Shapiro
+            # expansion.  Phi (phase from ascending node) = M + omega in
+            # the low-eccentricity ELL1 regime this model applies to.
+            phi = m_anom + om
+            delay = delay - (4.0 / 3.0) * self._h3_only * np.sin(3.0 * phi)
         return delay
 
     def dm_at(self, mjd):
@@ -499,14 +536,26 @@ class TimingModel:
     # -- phase -----------------------------------------------------------
 
     def _spin_phase(self, t_em_mjd):
-        """Taylor spin phase (longdouble cycles) at emission-frame TDB."""
-        dt = (np.asarray(t_em_mjd, np.longdouble)
-              - self.pepoch) * np.longdouble(_SEC_PER_DAY)
+        """Taylor spin phase (longdouble cycles) at emission-frame TDB,
+        plus post-glitch terms."""
+        t = np.asarray(t_em_mjd, np.longdouble)
+        dt = (t - self.pepoch) * np.longdouble(_SEC_PER_DAY)
         phase = np.zeros(dt.shape, np.longdouble)
         fact = np.longdouble(1.0)
         for n, fn in enumerate(self.f_terms):
             fact = fact * np.longdouble(n + 1)
             phase = phase + fn * dt ** (n + 1) / fact
+        for g in self.glitches:
+            dtg = np.asarray((t - g["ep"]) * np.longdouble(_SEC_PER_DAY),
+                             np.float64)
+            on = dtg >= 0.0
+            dtg = np.where(on, dtg, 0.0)
+            gph = (g["ph"] + g["f0"] * dtg + g["f1"] / 2.0 * dtg**2
+                   + g["f2"] / 6.0 * dtg**3)
+            if g["f0d"] and g["td_s"]:
+                gph = gph + g["f0d"] * g["td_s"] * (
+                    1.0 - np.exp(-dtg / g["td_s"]))
+            phase = phase + np.where(on, gph, 0.0).astype(np.longdouble)
         return phase
 
     def _phase_raw(self, mjd_utc, freq_mhz=None, site="@"):

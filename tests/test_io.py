@@ -153,7 +153,9 @@ class TestPolyco:
 
         base, _ = self._write_par(tmp_path)
         base_text = open(base).read()
-        for extra in ("GLEP_1 55000", "UNITS TCB", "BINARY T2",
+        # GLEP_1 alone is accepted since round 5 (glitch terms
+        # implemented); GLWEIRD_1 stands in as the unknown-glitch case
+        for extra in ("GLWEIRD_1 1.0", "UNITS TCB", "BINARY T2",
                       "FB1 1e-20", "PB 67.8"):
             par = str(tmp_path / "bad.par")
             with open(par, "w") as f:
@@ -315,6 +317,10 @@ class TestPSRFITS:
         pfit = PSRFITS(path="/tmp/x.fits", template=TEMPLATE, obs_mode="PSR")
         with pytest.raises(NotImplementedError):
             pfit.append(None)
+        # the reference RETURNS NotImplementedError from to_psrfits
+        # (io/psrfits.py:520) — we raise (DIVERGENCES #26)
+        with pytest.raises(NotImplementedError):
+            pfit.to_psrfits()
 
 
 class TestTxtFile:
