@@ -91,6 +91,7 @@ class SPKKernel:
                              "for SPK")
         (fward,) = struct.unpack(e + "i", self._raw[76:80])
         self.segments = []
+        self._skipped_frames = {}  # body -> {non-J2000 frame ids seen}
         self._parse_summaries(fward)
         self._by_target = {}
         for seg in self.segments:
@@ -126,11 +127,14 @@ class SPKKernel:
                     # 1 = J2000/ICRF, the only frame this module's
                     # consumers (equatorial barycentering) can accept;
                     # silently rotating e.g. ECLIPJ2000 vectors would
-                    # corrupt Roemer delays by the obliquity
-                    raise ValueError(
-                        f"{self.path}: segment for body {target} is in "
-                        f"frame {frame}; only J2000 (frame 1) is "
-                        "supported")
+                    # corrupt Roemer delays by the obliquity.  Merged or
+                    # augmented kernels routinely carry e.g. lunar-frame
+                    # segments for bodies this module never queries, so a
+                    # non-J2000 segment is SKIPPED here (like unsupported
+                    # data types) and only rejected if a query actually
+                    # needs it (_eval_body names the skipped frame then).
+                    self._skipped_frames.setdefault(target, set()).add(frame)
+                    continue
                 self.segments.append(self._finish_segment(
                     _Segment(target, center, frame, dtype, start, end,
                              et0, et1)))
@@ -179,9 +183,13 @@ class SPKKernel:
             remaining &= ~m
         if np.any(remaining):
             bad = et[remaining][0]
+            skipped = sorted(self._skipped_frames.get(body, ()))
+            hint = (f" (the kernel has segments for this body only in "
+                    f"non-J2000 frame(s) {skipped}, which were skipped "
+                    "at load)" if skipped else "")
             raise ValueError(
-                f"{self.path}: no type-2/3 segment for body {body} "
-                f"covering ET {bad:.0f} s past J2000")
+                f"{self.path}: no J2000 type-2/3 segment for body {body} "
+                f"covering ET {bad:.0f} s past J2000{hint}")
         return pos, centers
 
     def position(self, target, et, center=SSB):

@@ -71,9 +71,9 @@ class TestReaderExactness:
         write_spk_type2(path, [dict(target=SUN, center=SSB, init=0.0,
                                     intlen=100.0, coeffs=c)])
         k = SPKKernel(path)
-        with pytest.raises(ValueError, match="no type-2/3 segment"):
+        with pytest.raises(ValueError, match="no J2000 type-2/3 segment"):
             k.position(SUN, 1e9)
-        with pytest.raises(ValueError, match="no type-2/3 segment"):
+        with pytest.raises(ValueError, match="no J2000 type-2/3 segment"):
             k.position(EARTH, 50.0)
 
 
@@ -159,16 +159,72 @@ class TestRobustness:
         got = k.position(SUN, np.asarray([50.0, 150.0, 250.0, 350.0]))
         np.testing.assert_allclose(got[:, 0], [1.0, 1.0, 2.0, 2.0])
         # a gap epoch raises even when the FIRST epoch is covered
-        with pytest.raises(ValueError, match="no type-2/3 segment"):
+        with pytest.raises(ValueError, match="no J2000 type-2/3 segment"):
             k.position(SUN, np.asarray([50.0, 500.0]))
 
-    def test_non_j2000_frame_rejected(self, tmp_path):
+    def test_non_j2000_segments_skipped_not_fatal(self, tmp_path):
+        """A merged kernel carrying non-J2000 segments for bodies we never
+        query must still load and answer J2000 queries (advisor r4); only
+        a query that NEEDS the skipped segment raises, naming the frame."""
         c = np.zeros((1, 3, 2))
-        path = str(tmp_path / "ecl.bsp")
-        write_spk_type2(path, [dict(target=SUN, center=SSB, init=0.0,
-                                    intlen=100.0, coeffs=c, frame=17)])
-        with pytest.raises(ValueError, match="frame 17"):
-            SPKKernel(path)
+        c_sun = np.zeros((1, 3, 2))
+        c_sun[0, :, 0] = [7.0, 8.0, 9.0]
+        path = str(tmp_path / "merged.bsp")
+        write_spk_type2(path, [
+            # usable J2000 Sun segment
+            dict(target=SUN, center=SSB, init=0.0, intlen=100.0,
+                 coeffs=c_sun, frame=1),
+            # ECLIPJ2000 segment for a body we may or may not query
+            dict(target=301, center=3, init=0.0, intlen=100.0,
+                 coeffs=c, frame=17),
+        ])
+        k = SPKKernel(path)   # loads despite the frame-17 segment
+        np.testing.assert_allclose(k.position(SUN, 50.0), [7.0, 8.0, 9.0])
+        # querying the body whose only segments were skipped names the
+        # skipped frame in the error
+        with pytest.raises(ValueError, match=r"non-J2000 frame\(s\) \[17\]"):
+            k.position(301, 50.0)
+
+
+class TestSimulationHook:
+    def test_simulation_level_ephemeris_kernel_to_card(self, tmp_path):
+        """VERDICT r4 #7: one user step from a .bsp to JPL-grade PSRFITS —
+        Simulation(ephemeris=...) activates the kernel and the written
+        file's EPHEM card names it."""
+        import os
+
+        from psrsigsim_tpu.io import FitsFile
+        from psrsigsim_tpu.simulate import Simulation
+
+        kpath = TestEphemerisIntegration()._analytic_kernel(
+            tmp_path, 55990.0, 32)
+        template = os.path.join(os.path.dirname(__file__), "..", "data",
+                                "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+        d = {
+            "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+            "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+            "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+            "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+            "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+            "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+            "rcvr_name": "R", "backend_samprate": 12.5,
+            "backend_name": "B", "tempfile": template,
+            "ephemeris": kpath,
+        }
+        cwd = os.getcwd()
+        os.chdir(tmp_path)  # save_simulation writes simpar.par in cwd
+        try:
+            sim = Simulation(psrdict=d)
+            assert ephem.ephemeris_name() == "FIT"
+            sim.simulate()
+            out = str(tmp_path / "hook.fits")
+            sim.save_simulation(outfile=out, MJD_start=55999.9861)
+            card = FitsFile.read(out)["PRIMARY"].header["EPHEM"]
+            assert str(card).strip() == "FIT"
+        finally:
+            os.chdir(cwd)
+            ephem.set_ephemeris(None)
+        assert ephem.ephemeris_name() == "ANALYTIC-VSOP87"
 
 
 class TestProvenanceCard:
