@@ -172,16 +172,56 @@ def coherent_dedispersion_transfer(nsamp, dm, fcent_mhz, bw_mhz, dt_us):
     return jnp.cos(phase), jnp.sin(phase)
 
 
+def _dedisperse_packed(flat, re, im, n):
+    """Filter ``(B, n)`` real streams with one shared real-output transfer
+    function via complex pair packing.
+
+    XLA's TPU rfft/irfft costs ~2.5x a complex fft/ifft of the SAME
+    length (measured on v5e at the 2^21-2^23 lengths baseband blocks
+    use), so the classic two-for-one trick is a ~5x stage win: pack
+    streams pairwise as z = x0 + i x1.  Because the filter output for a
+    real input is real, Y0 = H X0 and Y1 = H X1 combine linearly as
+    W = H_full Z — no hermitian unpacking is needed at all; the filtered
+    pair is just re(w), im(w).  ``re``/``im`` are the rfft-layout planes;
+    the full-grid H is their hermitian extension (n even).
+    """
+    b = flat.shape[0]
+    if b % 2:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((1, n), flat.dtype)], axis=0)
+    z = jax.lax.complex(flat[0::2, :], flat[1::2, :])
+    re = jnp.asarray(re)
+    im = jnp.asarray(im)
+    # hermitian extension of the rfft-layout planes, with H forced REAL
+    # at the DC and Nyquist bins — exactly what irfft(spec * H) does
+    # implicitly (it drops imaginary parts there); keeping them complex
+    # would leak a ~2/sqrt(n) cross-stream term between the packed pair
+    zero = jnp.zeros((1,), im.dtype)
+    re_f = jnp.concatenate([re, re[1:-1][::-1]])
+    im_f = jnp.concatenate([zero, im[1:-1], zero, -im[1:-1][::-1]])
+    h = jax.lax.complex(re_f, im_f).astype(z.dtype)
+    w = jnp.fft.ifft(jnp.fft.fft(z, axis=-1) * h, axis=-1)
+    y = jnp.stack([jnp.real(w), jnp.imag(w)], axis=1)  # (pairs, 2, n)
+    return y.reshape(-1, n)[:b]
+
+
 def coherent_dedisperse(data, dm, fcent_mhz, bw_mhz, dt_us):
     """Apply the coherent dispersion transfer function to ``(..., Nsamp)`` data.
 
-    One batched rFFT over all polarization channels (the reference loops
-    channels serially, psrsigsim/ism/ism.py:82-98).
+    One batched FFT over all polarization channels (the reference loops
+    channels serially, psrsigsim/ism/ism.py:82-98).  In-graph, pairs of
+    real streams (pols, overlap-save blocks, ...) are packed into complex
+    streams and filtered with ONE complex FFT pair each
+    (:func:`_dedisperse_packed`); the host path keeps the rFFT form.
     """
     n = data.shape[-1]
     re, im = coherent_dedispersion_transfer(n, dm, fcent_mhz, bw_mhz, dt_us)
     if _is_concrete(data) and _is_concrete(re):
         return _apply_spectral_filter(data, jnp.asarray(re), jnp.asarray(im), n)
+    if n % 2 == 0:
+        lead = data.shape[:-1]
+        out = _dedisperse_packed(data.reshape((-1, n)), re, im, n)
+        return out.reshape(lead + (n,))
     spec = jnp.fft.rfft(data, axis=-1)
     H = jax.lax.complex(jnp.asarray(re), jnp.asarray(im)).astype(spec.dtype)
     return jnp.fft.irfft(spec * H, n=n, axis=-1)

@@ -20,7 +20,8 @@ from jax import lax
 
 __all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm",
            "SEQ_RNG_BLOCK", "blocked_chan_chi2", "blocked_chan_normal",
-           "sampler_backend", "chan_chi2_field", "chan_normal_field"]
+           "sampler_backend", "chan_chi2_field", "chan_normal_field",
+           "flat_normal_field", "FLAT_TILE"]
 
 # Fixed span of global time samples per RNG key: ALL pipeline draws —
 # unsharded and sequence-sharded alike — are keyed by
@@ -255,6 +256,48 @@ def chan_normal_field(key, chan_ids, t0, length, block=SEQ_RNG_BLOCK,
         return _hw_field_span(key, chan_ids, 0.0, t0, "normal", length,
                               aligned)
     return blocked_chan_normal(key, chan_ids, t0, length, block, aligned)
+
+
+# one hardware-sampler tile: 8 channel sublanes x one RNG block
+FLAT_TILE = 8 * SEQ_RNG_BLOCK
+
+
+def flat_normal_field(key, f0, length):
+    """A 1-D standard-normal stream at GLOBAL flat offset ``f0``.
+
+    Few-channel consumers (the 2-polarization baseband fields) waste 3/4
+    of every hardware-sampler tile when drawn as per-channel rows — the
+    kernel always computes 8 channel sublanes (ops/rng_pallas.py).  A
+    flat stream instead flattens whole ``(8, SEQ_RNG_BLOCK)`` tiles in
+    ``(block, channel, sample)`` order, so every generated sample is
+    consumed regardless of the consumer's channel count.
+
+    Keying is the standard (channel group 0-7, global block) scheme on
+    whichever backend is active, so any span of the flat stream is
+    identical for any shard boundaries — callers map their global
+    samples to flat offsets (e.g. pol-major ``p*nsamp + t``) and slice.
+    Like every backend choice, the flat layout selects a REALIZATION of
+    the same distribution, never different statistics.
+
+    ``f0`` may be traced (sequence shards pass ``shard*L``); ``length``
+    is static.  Unaligned spans overdraw one tile and slice, exactly as
+    :func:`_hw_field_span` does per RNG block.
+    """
+    ch8 = jnp.arange(8)
+    if isinstance(f0, (int, np.integer)) and f0 % FLAT_TILE == 0:
+        nt = -(-length // FLAT_TILE)
+        b0 = f0 // FLAT_TILE
+        off = 0
+    else:
+        nt = -(-length // FLAT_TILE) + 1
+        b0 = jnp.asarray(f0, jnp.int32) // FLAT_TILE
+        off = jnp.asarray(f0, jnp.int32) - b0 * FLAT_TILE
+    field = chan_normal_field(key, ch8, b0 * SEQ_RNG_BLOCK,
+                              nt * SEQ_RNG_BLOCK, aligned=True)
+    flat = field.reshape(8, nt, SEQ_RNG_BLOCK).transpose(1, 0, 2).reshape(-1)
+    if isinstance(off, int) and off == 0 and flat.shape[0] == length:
+        return flat
+    return lax.dynamic_slice(flat, (jnp.asarray(off, jnp.int32),), (length,))
 
 
 def chi2_draw_norm(dtype, df):

@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.shift import coherent_dedisperse, fourier_shift
 from ..ops.stats import (SEQ_RNG_BLOCK, blocked_chan_chi2,
                          blocked_chan_normal, chan_chi2_field,
-                         chan_normal_field)
+                         chan_normal_field, flat_normal_field)
 from ..simulate.pipeline import (_dispersion_delays, _null_mask_at,
                                  _null_mask_row)
 from ..utils.rng import stage_key
@@ -282,25 +282,33 @@ def seq_sharded_baseband(cfg, dm, mesh=None, halo=None):
     mesh, n, L = _seq_prologue(cfg, mesh)
     dedisp = _make_dedisp_local(cfg, dm, n, L, halo)
 
-    aligned = (L % SEQ_RNG_BLOCK == 0)
-
     def _local(key, noise_norm, sqrt_profiles):
         shard = lax.axis_index(SEQ_AXIS)
         t0 = shard * L
         kp = stage_key(key, "pulse")
         kn = stage_key(key, "noise")
         npol = sqrt_profiles.shape[0]
-        chan_ids = jnp.arange(npol)
+
+        def _flat_rows(k):
+            # the unsharded pipeline draws its normals from the FLAT
+            # pol-major stream (pipeline.py baseband_pipeline /
+            # ops/stats.py flat_normal_field — full hw-sampler tile
+            # utilization at npol=2); shard s owns flat span
+            # [p*nsamp + t0, p*nsamp + t0 + L) of each pol, so drawing
+            # those spans reproduces the unsharded samples exactly for
+            # any shard count
+            return jnp.stack([
+                flat_normal_field(k, p * cfg.nsamp + t0, L)
+                for p in range(npol)
+            ])
 
         idx = (t0 + jnp.arange(L, dtype=jnp.int32)) % cfg.nph
         amp = jnp.take(sqrt_profiles, idx, axis=1)
-        block = amp * chan_normal_field(kp, chan_ids, t0, L,
-                                        aligned=aligned)
+        block = amp * _flat_rows(kp)
 
         block = dedisp(block)
 
-        noise = chan_normal_field(kn, chan_ids, t0, L, aligned=aligned)
-        return block + noise * noise_norm
+        return block + _flat_rows(kn) * noise_norm
 
     return jax.jit(
         shard_map(

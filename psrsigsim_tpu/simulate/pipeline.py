@@ -25,7 +25,8 @@ import numpy as np
 
 from ..ops.shift import (coherent_dedisperse, coherent_dedisperse_os,
                          fourier_shift, plan_dedisperse_os)
-from ..ops.stats import chan_chi2_field, chan_normal_field
+from ..ops.stats import (chan_chi2_field, chan_normal_field,
+                         flat_normal_field)
 from ..signal.state import SignalMeta
 from ..utils.constants import DM_K_MS_MHZ2
 from ..utils.rng import stage_key
@@ -105,11 +106,6 @@ def _chan_chi2(key, chan_ids, df, nsamp):
     streams.  Dispatches to the Pallas hardware sampler on TPU
     (ops/rng_pallas.py) or the blocked threefry draws (ops/stats.py)."""
     return chan_chi2_field(key, chan_ids, df, 0, nsamp, aligned=True)
-
-
-def _chan_normal(key, chan_ids, nsamp):
-    """Per-channel N(0,1) draws, block-keyed like :func:`_chan_chi2`."""
-    return chan_normal_field(key, chan_ids, 0, nsamp, aligned=True)
 
 
 def _dispersion_delays(dm, freqs, extra_delays_ms):
@@ -587,7 +583,7 @@ class BasebandPipelineConfig:
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg, chan_ids=None):
+def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg):
     """One baseband observation as one XLA program: amplitude synthesis
     (sqrt-profile x N(0,1); reference pulsar.py:153-183), coherent
     dispersion (all pol channels in one batched FFT; reference ism.py:76-98
@@ -598,8 +594,10 @@ def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg, chan_ids=None):
         key, dm, noise_norm: as :func:`fold_pipeline` (noise_norm from
             :meth:`Receiver._amp_noise_norm` semantics; 0 to disable).
         sqrt_profiles: ``sqrt(profile)`` at each phase bin, ``(Npol, Nph)``.
-        cfg: static :class:`BasebandPipelineConfig`.
-        chan_ids: global pol-channel indices (shard invariance).
+        cfg: static :class:`BasebandPipelineConfig`.  Draws come from the
+            FLAT pol-major stream (flat_normal_field), so there is no
+            per-channel keying to parameterize; time sharding reproduces
+            the stream via the same flat spans (parallel/seqshard.py).
 
     Returns ``(Npol, nsamp)`` float32.
 
@@ -609,13 +607,17 @@ def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg, chan_ids=None):
     """
     kp = stage_key(key, "pulse")
     kn = stage_key(key, "noise")
-    if chan_ids is None:
-        chan_ids = jnp.arange(sqrt_profiles.shape[0])
 
     nsamp = cfg.nsamp
+    npol = sqrt_profiles.shape[0]
     amp = _tile_periodic(sqrt_profiles, nsamp)
 
-    block = amp * _chan_normal(kp, chan_ids, nsamp)
+    # normals come from the FLAT (pol-major) stream: with only 2 pol
+    # channels, per-channel rows would waste 3/4 of every 8-sublane
+    # hardware-sampler tile (ops/stats.py flat_normal_field); the
+    # sequence-sharded pipeline draws the same flat spans, so sharded ==
+    # unsharded holds sample-for-sample (tests/test_seqshard_baseband.py)
+    block = amp * flat_normal_field(kp, 0, npol * nsamp).reshape(npol, nsamp)
 
     if cfg.os_plan is not None:
         block = coherent_dedisperse_os(
@@ -626,7 +628,8 @@ def baseband_pipeline(key, dm, noise_norm, sqrt_profiles, cfg, chan_ids=None):
             block, dm, cfg.fcent_mhz, cfg.bw_mhz, cfg.dt_us
         )
 
-    return block + _chan_normal(kn, chan_ids, nsamp) * noise_norm
+    noise = flat_normal_field(kn, 0, npol * nsamp).reshape(npol, nsamp)
+    return block + noise * noise_norm
 
 
 def build_baseband_config(signal, pulsar, telescope=None, system=None,
