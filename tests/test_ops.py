@@ -289,3 +289,54 @@ class TestShiftPrecision:
         assert isinstance(re2, np.ndarray)  # host float64 path, not traced
         np.testing.assert_array_equal(np.asarray(re1), np.asarray(re2))
         np.testing.assert_array_equal(np.asarray(im1), np.asarray(im2))
+
+
+class TestFlatNormalField:
+    """Round-5 flat sampler stream (ops/stats.py flat_normal_field):
+    whole (8-channel x RNG-block) tiles flattened (block, channel,
+    sample)-major, so few-channel baseband fields use every generated
+    sample."""
+
+    def test_tile_construction_matches_chan_field(self):
+        import jax
+        import jax.numpy as jnp
+
+        from psrsigsim_tpu.ops.stats import (FLAT_TILE, SEQ_RNG_BLOCK,
+                                             chan_normal_field,
+                                             flat_normal_field)
+
+        key = jax.random.key(7)
+        nt = 3
+        flat = np.asarray(flat_normal_field(key, 0, nt * FLAT_TILE))
+        field = np.asarray(chan_normal_field(
+            key, jnp.arange(8), 0, nt * SEQ_RNG_BLOCK, aligned=True))
+        expect = field.reshape(8, nt, SEQ_RNG_BLOCK).transpose(1, 0, 2)
+        np.testing.assert_array_equal(flat, expect.reshape(-1))
+
+    def test_any_span_reproduces_the_global_stream(self):
+        import jax
+        import jax.numpy as jnp
+
+        from psrsigsim_tpu.ops.stats import FLAT_TILE, flat_normal_field
+
+        key = jax.random.key(3)
+        whole = np.asarray(flat_normal_field(key, 0, 2 * FLAT_TILE))
+        # unaligned static span
+        f0, ln = 12345, 40000
+        span = np.asarray(flat_normal_field(key, f0, ln))
+        np.testing.assert_array_equal(span, whole[f0:f0 + ln])
+        # traced offset (the seq-sharded path's shard*L)
+        span_t = np.asarray(jax.jit(
+            lambda o: flat_normal_field(key, o, ln)
+        )(jnp.int32(f0)))
+        np.testing.assert_array_equal(span_t, whole[f0:f0 + ln])
+
+    def test_statistics(self):
+        import jax
+
+        from psrsigsim_tpu.ops.stats import FLAT_TILE, flat_normal_field
+
+        x = np.asarray(flat_normal_field(jax.random.key(11), 0,
+                                         8 * FLAT_TILE))
+        assert abs(x.mean()) < 5e-3
+        assert abs(x.std() - 1.0) < 5e-3

@@ -141,6 +141,10 @@ class TestSeqShardedSearch:
         # nulling removes pulsed power
         assert nulled.sum() < clean.sum()
 
+    @pytest.mark.skipif(len(jax.devices()) < 4,
+                        reason="needs a 4-device seq mesh (on 1 real chip "
+                               "make_seq_mesh(4) itself raises, passing the "
+                               "raises-check for the wrong reason)")
     def test_rejects_indivisible_axes(self):
         import dataclasses
 
