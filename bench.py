@@ -409,17 +409,23 @@ def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None,
     diagnostics (``slope_ok`` etc.).
     """
     is_fold = pipeline is None
+    is_baseband = hasattr(cfg, "os_plan")
     if pipeline is None:
         from psrsigsim_tpu.simulate import fold_pipeline as pipeline
 
     if batch is None:
         # keep one program's working set well inside a single chip's HBM;
         # fold-mode programs (default pipeline) are elementwise-light and
-        # benefit from wider batches, the FFT-bound baseband/SEARCH
-        # pipelines hold big spectral temporaries per observation
+        # benefit from wider batches; the FFT-bound baseband pipeline
+        # holds big spectral temporaries per observation (batch 16 is no
+        # faster than 8, measured r5); SEARCH is elementwise like fold —
+        # the batch-1 its old 1<<26 budget forced was ~3x slower per obs
+        # than wider batches (r5 A/B: 19.3 ms at batch 1, 6.6 at batch 4,
+        # 5.7-6.9 at the batch 5 this 1<<28 budget yields on config4)
         # (is_fold captured BEFORE the default import rebinds pipeline —
         # advisor round 4 caught the 1<<27 arm being dead)
-        budget = (1 << 27) if is_fold else (1 << 26)
+        budget = (1 << 26) if is_baseband else (1 << 28 if not is_fold
+                                                else 1 << 27)
         batch = max(1, budget // (cfg.meta.nchan * cfg.nsamp))
     prof = np.asarray(profiles, np.float32)
 
@@ -441,7 +447,10 @@ def time_tpu_single(cfg, profiles, noise_norm, dm, batch=None,
         return run_k(kb, jnp.float32(dm), k)
 
     slope, _, sdiag = _timed_slope(call, 2, 10)
-    sync = _sync_probe(lambda s: call(2, s))
+    # probe at the LARGER width: for fast programs a k=2 call is ~90%
+    # fixed dispatch cost, and the blocked/fetched ratio would measure
+    # relay jitter, not execution honesty
+    sync = _sync_probe(lambda s: call(10, s))
     return slope / batch, sync, sdiag
 
 
@@ -544,10 +553,11 @@ def time_tpu_multipulsar(n_pulsars=128, epochs=8, epoch_chunk=2):
             lambda k, seed: _run_k(jax.random.key(seed), k), 2, 10)
         slope_oks.append(sdiag["slope_ok"])
         total_slope += slope  # sec per e_blk epochs of THIS bucket
-        # probe with the k=2 program _timed_slope already compiled (a
-        # cold program's compile time would swamp the blocked/fetched
-        # ratio)
-        syncs.append(_sync_probe(lambda s: _run_k(jax.random.key(s), 2)))
+        # probe with the k=10 program _timed_slope already compiled (a
+        # cold program's compile would swamp the ratio, and a small-k
+        # call is mostly fixed dispatch cost — relay jitter, not
+        # execution honesty)
+        syncs.append(_sync_probe(lambda s: _run_k(jax.random.key(s), 10)))
 
     sec_per_epoch = total_slope / e_blk
     sync = round(float(np.median(syncs)), 3)
@@ -625,7 +635,7 @@ def time_tpu_ensemble(sim, dm):
                      jnp.asarray(norms), k)
 
     slope, _, sdiag = _timed_slope(call, 1, 1 + ENSEMBLE_BATCHES)
-    sync = _sync_probe(lambda s: call(1, s))
+    sync = _sync_probe(lambda s: call(1 + ENSEMBLE_BATCHES, s))
     return slope / ENSEMBLE_BATCH, sync, sdiag
 
 
