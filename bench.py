@@ -323,7 +323,10 @@ def build_baseband_workload():
 # 5: Monte-Carlo ensemble of config-1 observations (BASELINE.md config 5).
 # Batch sized to fit one program's working set in a single v5e chip's HBM
 # (the 10k-obs target streams these batches back-to-back).
-ENSEMBLE_BATCH = 64  # A/B (round 4): 64 is ~13% faster per obs than 32
+# A/B r4: 64 ~13% faster than 32; r5: 128 ~7% faster than 64 (3441 vs
+# 3206 obs/s), 256 regresses (3056) — the 1.3 GB accumulator of 128 is
+# the sweet spot
+ENSEMBLE_BATCH = 128
 ENSEMBLE_BATCHES = 8
 
 
