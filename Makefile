@@ -1,0 +1,10 @@
+# Developer entry points.  CI needs no extra plumbing: `make lint` is also
+# collected by the ordinary pytest run (tests/test_psrlint.py).
+
+.PHONY: lint test
+
+lint:
+	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

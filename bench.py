@@ -747,8 +747,15 @@ def time_export_e2e(n_obs=None):
         )
         t_compute = slope / qn
 
-        # link: one chunk's device->host fetch
-        dev = ens.run_quantized(chunk, seed=4, byte_order="big")
+        # link: one chunk's device->host fetch.  The big-endian program is
+        # the exporter's private transport encoding (run_quantized no
+        # longer exposes byte_order — ADVICE r5 #3), so drive it the way
+        # iter_chunks does: prepped inputs into the BE-swapped program.
+        keys_q, dms_c, norms_c, pad_q = ens._prep_inputs(chunk, 4, None, None)
+        dev = ens._run_sharded_quantized_be(
+            keys_q, dms_c, norms_c, ens._profiles, ens._freqs, ens._chan_ids)
+        if pad_q:
+            dev = tuple(a[:chunk] for a in dev)
         jax.block_until_ready(dev)
         t0 = time.perf_counter()
         host = jax.device_get(dev)

@@ -1,0 +1,36 @@
+"""psrlint: static + dynamic correctness gates for the TPU pipeline.
+
+The tier-1 CPU suite proves numerics; it cannot prove *trace hygiene* —
+Python branching on traced values, host ``np.`` round-trips inside
+jitted ops, reused PRNG keys, float64 leaks, process-global state, and
+phantom sharding axes all pass CPU tests and then corrupt or de-scale
+the real TPU workload.  This package gates those classes in CI:
+
+* :func:`run_lint` / ``python -m psrsigsim_tpu.analysis`` — AST checkers
+  with stable rule IDs (``PSR101``-``PSR106``), inline suppression
+  (``# psrlint: disable=RULE``), and a per-(rule, file) count-ratchet
+  baseline (``analysis/baseline.txt``).
+* :func:`run_trace_check` — traces every public ``ops`` symbol under
+  ``jax.make_jaxpr``/``jax.eval_shape`` on canonical shapes and asserts
+  a stable jit cache, cross-checking the linter's static claims.
+
+See docs/static_analysis.md for the rule catalog and workflow.
+"""
+
+from .core import (Finding, LintConfig, RULES, baseline_regressions,
+                   load_baseline, load_config, run_lint, write_baseline)
+from .trace_check import EXEMPT, probe_specs, run_trace_check
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "RULES",
+    "run_lint",
+    "load_config",
+    "load_baseline",
+    "write_baseline",
+    "baseline_regressions",
+    "run_trace_check",
+    "probe_specs",
+    "EXEMPT",
+]

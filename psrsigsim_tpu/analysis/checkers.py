@@ -1,0 +1,712 @@
+"""psrlint checkers: the six rule implementations.
+
+Every checker is a small AST pass over one module
+(:class:`~psrsigsim_tpu.analysis.core.ModuleContext`).  They share the
+import-alias resolver and the jit-reachability walk below; none of them
+imports jax — static claims are cross-checked at trace time by
+:mod:`psrsigsim_tpu.analysis.trace_check` instead.
+
+Heuristics are tuned for THIS codebase's idioms (documented per rule in
+docs/static_analysis.md):
+
+* branching on ``_is_concrete(x)`` is the sanctioned concrete/traced
+  fork — np/scipy work inside the concrete branch is host-side by
+  construction and exempt from PSR102;
+* ``float(x)`` inside a ``try`` with a handler is the sanctioned
+  "is this traced?" probe (ops/stats.py) and exempt from PSR101;
+* ``stage_key``/``fold_in`` DERIVE keys and may be applied repeatedly to
+  one root; samplers CONSUME keys and may see each key once (PSR103).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, RULES
+
+__all__ = ["default_checkers"]
+
+_JIT_WRAPPERS = {
+    "jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit",
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.lax.map", "lax.map",
+}
+_TRACED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.", "jnp.")
+_RNG_DERIVERS = {"split", "fold_in", "stage_key", "next_key", "clone"}
+_RNG_NONCONSUMING = {"key", "PRNGKey", "key_data", "wrap_key_data",
+                     "key_impl", "unsafe_rbg_key"}
+_DTYPE_TOKENS = {
+    "dtype", "float16", "bfloat16", "float32", "float64", "int8", "int16",
+    "int32", "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+    "complex64", "complex128",
+}
+_JNP_CONSTRUCTORS = {"array", "asarray", "full", "full_like", "zeros",
+                     "ones", "arange", "linspace"}
+
+
+def _aliases(tree):
+    """Map local names to canonical dotted import paths."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    out[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Resolver:
+    def __init__(self, tree):
+        self.aliases = _aliases(tree)
+
+    def resolve(self, node):
+        """Canonical dotted path of a Name/Attribute expr, or None."""
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        first, _, rest = dotted.partition(".")
+        base = self.aliases.get(first, first)
+        return f"{base}.{rest}" if rest else base
+
+    def call_name(self, call):
+        return self.resolve(call.func) if isinstance(call, ast.Call) else None
+
+
+def _is_jnp(resolved):
+    return bool(resolved) and resolved.startswith(_TRACED_PREFIXES)
+
+
+def _walk_no_nested_defs(node):
+    """Walk an AST subtree WITHOUT descending into nested function/class
+    scopes (their bodies are visited when that scope is analyzed)."""
+    stack = [node]
+    first = True
+    while stack:
+        cur = stack.pop()
+        if not first and isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Lambda)
+        ):
+            continue
+        first = False
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+# -- jit reachability --------------------------------------------------------
+
+class _FunctionIndex:
+    """All function-like scopes in a module + which are jit-reachable."""
+
+    def __init__(self, ctx, res):
+        self.funcs = []       # (node, name, parent_chain)
+        self.by_name = {}
+        self._collect(ctx.tree)
+        self.reachable = self._reach(ctx, res)
+
+    def _collect(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.append(node)
+                self.by_name.setdefault(node.name, node)
+            elif isinstance(node, ast.Lambda):
+                self.funcs.append(node)
+
+    def _roots(self, ctx, res):
+        roots = set()
+        if ctx.assume_jitted():
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    roots.add(node)
+        for fn in self.funcs:
+            for deco in getattr(fn, "decorator_list", []):
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                name = res.resolve(target)
+                if name in _JIT_WRAPPERS:
+                    roots.add(fn)
+                elif (isinstance(deco, ast.Call)
+                      and name in ("functools.partial", "partial")
+                      and deco.args
+                      and res.resolve(deco.args[0]) in _JIT_WRAPPERS):
+                    roots.add(fn)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Call)
+                    and res.call_name(node) in _JIT_WRAPPERS and node.args):
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    roots.add(arg)
+                elif (isinstance(arg, ast.Name)
+                      and arg.id in self.by_name):
+                    roots.add(self.by_name[arg.id])
+        return roots
+
+    def _reach(self, ctx, res):
+        reachable = set(self._roots(ctx, res))
+        frontier = list(reachable)
+        while frontier:
+            fn = frontier.pop()
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    callee = None
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)):
+                        callee = self.by_name.get(node.func.id)
+                    if callee is not None and callee not in reachable:
+                        reachable.add(callee)
+                        frontier.append(callee)
+        return reachable
+
+
+def _resolver_of(ctx):
+    """Per-module resolver, built once and shared by every checker."""
+    res = ctx.cache.get("resolver")
+    if res is None:
+        res = ctx.cache["resolver"] = _Resolver(ctx.tree)
+    return res
+
+
+def _index_of(ctx):
+    """Per-module function index + jit reachability, built once."""
+    idx = ctx.cache.get("func_index")
+    if idx is None:
+        idx = ctx.cache["func_index"] = _FunctionIndex(ctx, _resolver_of(ctx))
+    return idx
+
+
+def _guarded_of(ctx):
+    """Per-module ``_is_concrete``-guarded node ids, built once (used by
+    both PSR102 and PSR104)."""
+    ids = ctx.cache.get("guarded_ids")
+    if ids is None:
+        ids = ctx.cache["guarded_ids"] = _concrete_guarded_ids(
+            ctx.tree, _resolver_of(ctx))
+    return ids
+
+
+def _func_line(fn):
+    return getattr(fn, "lineno", 0)
+
+
+def _concrete_guarded_ids(root, res):
+    """ids of nodes inside ``if _is_concrete(...)`` bodies — the sanctioned
+    host/traced fork (ops/shift.py): host numpy/float64 work there runs at
+    trace time on concrete values by construction."""
+    exempt = set()
+    for node in ast.walk(root):
+        if not isinstance(node, ast.If):
+            continue
+        guarded = any(
+            isinstance(t, ast.Call)
+            and (res.call_name(t) or "").split(".")[-1] == "_is_concrete"
+            for t in ast.walk(node.test)
+        )
+        if guarded:
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    exempt.add(id(sub))
+    return exempt
+
+
+def _body_stmts(fn):
+    return fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+
+
+# -- PSR101: trace safety ----------------------------------------------------
+
+class TraceSafetyChecker:
+    rule = "PSR101"
+
+    def check(self, ctx):
+        res = _resolver_of(ctx)
+        index = _index_of(ctx)
+        severity = RULES[self.rule][0]
+        for fn in index.funcs:
+            if fn not in index.reachable:
+                continue
+            yield from self._check_fn(ctx, res, fn, severity)
+
+    def _check_fn(self, ctx, res, fn, severity):
+        derived = set()
+        in_probe_try = set()
+        assigns = []
+        for node in _walk_no_nested_defs(fn):
+            if isinstance(node, ast.Try) and node.handlers:
+                for sub in ast.walk(node):
+                    in_probe_try.add(id(sub))
+            if isinstance(node, ast.Assign):
+                assigns.append(node)
+        # taint assignments to a FIXPOINT: the walk order is arbitrary,
+        # and `b = a + 1` must become traced whenever `a = jnp.zeros(3)`
+        # does, regardless of which assignment is seen first
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                if not self._traced(node.value, res, derived):
+                    continue
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name) and n.id not in derived:
+                            derived.add(n.id)
+                            changed = True
+
+        def finding(node, msg):
+            return Finding(ctx.rel, node.lineno, node.col_offset, self.rule,
+                           msg, severity, func_line=_func_line(fn))
+
+        for node in _walk_no_nested_defs(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if self._traced_test(node.test, res, derived):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield finding(
+                        node, f"`{kind}` branches on a traced value inside "
+                              "jit-reachable code; use jnp.where / "
+                              "lax.cond or hoist to a static argument")
+            elif isinstance(node, ast.Assert):
+                if self._traced_test(node.test, res, derived):
+                    yield finding(
+                        node, "`assert` on a traced value never runs under "
+                              "jit; use checkify or validate statically")
+            elif isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and id(node) not in in_probe_try
+                        and self._traced(node.args[0], res, derived)):
+                    yield finding(
+                        node, f"`{node.func.id}()` forces a traced value "
+                              "concrete (ConcretizationTypeError under "
+                              "jit / silent host sync otherwise)")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "item"
+                      and not node.args
+                      and self._traced(node.func.value, res, derived)):
+                    yield finding(
+                        node, "`.item()` on a traced value forces a host "
+                              "round-trip inside jit-reachable code")
+
+    # attribute reads that are STATIC on tracers (shape/dtype metadata)
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type",
+                     "sharding", "itemsize"}
+
+    @classmethod
+    def _traced_test(cls, expr, res, derived):
+        """A branch test containing ``isinstance(...)`` anywhere is the
+        static type-dispatch fork — never flagged as a whole."""
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "isinstance"):
+                return False
+        return cls._traced(expr, res, derived)
+
+    @classmethod
+    def _traced(cls, expr, res, derived):
+        """Whether evaluating ``expr`` can touch a traced VALUE.
+
+        Deliberately not flagged: ``x.shape``-style metadata reads (static
+        under trace), ``x is None`` identity checks, and any expression
+        containing an ``isinstance`` call (the static type-dispatch fork,
+        e.g. ops/stats.py's concrete/traced ``off`` split)."""
+        if isinstance(expr, ast.Attribute) and expr.attr in cls._STATIC_ATTRS:
+            return False
+        if isinstance(expr, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+        ):
+            return False
+        if isinstance(expr, ast.Call):
+            if (isinstance(expr.func, ast.Name)
+                    and expr.func.id == "isinstance"):
+                return False
+            if _is_jnp(res.call_name(expr)):
+                return True
+        if isinstance(expr, ast.Name):
+            return expr.id in derived
+        return any(cls._traced(child, res, derived)
+                   for child in ast.iter_child_nodes(expr))
+
+
+# -- PSR102: host numpy/scipy leakage ---------------------------------------
+
+class HostNumpyChecker:
+    rule = "PSR102"
+
+    def check(self, ctx):
+        if not ctx.in_device_modules():
+            return
+        res = _resolver_of(ctx)
+        index = _index_of(ctx)
+        severity = RULES[self.rule][0]
+        allow = set(ctx.config.numpy_allow)
+        exempt = _guarded_of(ctx)
+        for fn in index.funcs:
+            if fn not in index.reachable:
+                continue
+            for node in _walk_no_nested_defs(fn):
+                if not isinstance(node, ast.Call) or id(node) in exempt:
+                    continue
+                name = res.call_name(node)
+                if not name or not name.startswith(("numpy.", "scipy.")):
+                    continue
+                if name.split(".")[-1] in allow:
+                    continue
+                yield Finding(
+                    ctx.rel, node.lineno, node.col_offset, self.rule,
+                    f"host call `{name}` inside the jitted pipeline "
+                    "forces a host round-trip (use jax.numpy, or move "
+                    "to config/staging time)", severity,
+                    func_line=_func_line(fn))
+
+# -- PSR103: RNG key discipline ---------------------------------------------
+
+_RANK = {"fresh": 0, "derived": 1, "sunk": 2}
+
+
+class RngReuseChecker:
+    rule = "PSR103"
+
+    def check(self, ctx):
+        res = _resolver_of(ctx)
+        index = _index_of(ctx)
+        severity = RULES[self.rule][0]
+        sinks = set(ctx.config.rng_sinks)
+        seen = set()
+        for fn in index.funcs:
+            findings = []
+            self._scan_block(_body_stmts(fn), {}, res, sinks, findings,
+                             ctx, fn, severity)
+            for f in findings:
+                key = (f.line, f.col, f.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield f
+
+    # one statement's rng events, in source order, no nested scopes
+    def _events(self, stmt, res, sinks):
+        events = []
+        for node in _walk_no_nested_defs(stmt):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = res.call_name(node)
+            if not name:
+                continue
+            last = name.split(".")[-1]
+            arg = node.args[0]
+            if not isinstance(arg, ast.Name):
+                continue
+            if name.startswith("jax.random."):
+                if last in _RNG_DERIVERS:
+                    events.append(("derive", arg.id, node))
+                elif last not in _RNG_NONCONSUMING:
+                    events.append(("sink", arg.id, node))
+            elif last in _RNG_DERIVERS:
+                events.append(("derive", arg.id, node))
+            elif last in sinks:
+                events.append(("sink", arg.id, node))
+        events.sort(key=lambda e: (e[2].lineno, e[2].col_offset))
+        return events
+
+    def _apply(self, stmt, state, res, sinks, findings, ctx, fn, severity):
+        for kind, key, node in self._events(stmt, res, sinks):
+            status = state.get(key)
+            if kind == "sink":
+                if status in ("derived", "sunk"):
+                    how = ("already consumed by a sampler"
+                           if status == "sunk"
+                           else "already used to derive subkeys")
+                    findings.append(Finding(
+                        ctx.rel, node.lineno, node.col_offset, self.rule,
+                        f"PRNG key `{key}` {how}; pass a fresh "
+                        "jax.random.split/fold_in product instead of "
+                        "reusing it", severity,
+                        func_line=_func_line(fn)))
+                state[key] = "sunk"
+            else:
+                if status == "sunk":
+                    findings.append(Finding(
+                        ctx.rel, node.lineno, node.col_offset, self.rule,
+                        f"PRNG key `{key}` was consumed by a sampler and "
+                        "is now re-derived; derive before sampling",
+                        severity, func_line=_func_line(fn)))
+                elif status != "sunk":
+                    state[key] = "derived"
+        # plain reassignment of a name resets its key state
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        state.pop(n.id, None)
+
+    def _merge(self, states):
+        live = [s for s in states if s is not None]
+        if not live:
+            return None
+        merged = {}
+        for s in live:
+            for k, v in s.items():
+                if k not in merged or _RANK[v] > _RANK[merged[k]]:
+                    merged[k] = v
+        return merged
+
+    def _scan_block(self, stmts, state, res, sinks, findings, ctx, fn,
+                    severity):
+        """Abstract interpretation of one statement list; returns the exit
+        state or None when every path terminates (return/raise)."""
+        args = (res, sinks, findings, ctx, fn, severity)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                self._apply(stmt, state, *args)
+                return None
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                self._apply(ast.Expr(stmt.test), state, *args)
+                s1 = self._scan_block(stmt.body, dict(state), *args)
+                s2 = self._scan_block(stmt.orelse, dict(state), *args)
+                merged = self._merge([s1, s2])
+                if merged is None:
+                    return None
+                state.clear()
+                state.update(merged)
+            elif isinstance(stmt, (ast.For, ast.While)):
+                head = stmt.iter if isinstance(stmt, ast.For) else stmt.test
+                self._apply(ast.Expr(head), state, *args)
+                if isinstance(stmt, ast.For):
+                    for n in ast.walk(stmt.target):
+                        if isinstance(n, ast.Name):
+                            state.pop(n.id, None)
+                # two passes: the second exposes cross-iteration key reuse
+                s1 = self._scan_block(list(stmt.body), dict(state), *args)
+                if s1 is not None:
+                    s2 = self._scan_block(list(stmt.body), dict(s1), *args)
+                    merged = self._merge([state, s1, s2])
+                    state.clear()
+                    state.update(merged)
+                s3 = self._scan_block(stmt.orelse, dict(state), *args)
+                if s3 is not None:
+                    state.update(s3)
+            elif isinstance(stmt, ast.Try):
+                s1 = self._scan_block(stmt.body, dict(state), *args)
+                hs = [self._scan_block(h.body, dict(state), *args)
+                      for h in stmt.handlers]
+                merged = self._merge([s1] + hs)
+                if merged is None and not stmt.finalbody:
+                    return None
+                state.clear()
+                state.update(merged or {})
+                sf = self._scan_block(stmt.finalbody, state, *args)
+                if sf is None:
+                    return None
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._apply(ast.Expr(item.context_expr), state, *args)
+                sb = self._scan_block(stmt.body, state, *args)
+                if sb is None:
+                    return None
+            else:
+                self._apply(stmt, state, *args)
+        return state
+
+
+# -- PSR104: dtype hygiene ---------------------------------------------------
+
+class DtypeChecker:
+    rule = "PSR104"
+
+    def check(self, ctx):
+        if not ctx.in_device_modules():
+            return
+        res = _resolver_of(ctx)
+        severity = RULES[self.rule][0]
+        exempt = _guarded_of(ctx)
+        func_stack = []
+
+        def fline():
+            return func_stack[-1] if func_stack else 0
+
+        def visit(node):
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                func_stack.append(node.lineno)
+            if id(node) not in exempt:
+                yield from self._check_node(ctx, res, node, severity, fline())
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if is_fn:
+                func_stack.pop()
+
+        yield from visit(ctx.tree)
+
+    def _check_node(self, ctx, res, node, severity, func_line):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            name = res.resolve(node)
+            if name in ("numpy.float64", "jax.numpy.float64",
+                        "numpy.float128", "numpy.longdouble"):
+                yield Finding(
+                    ctx.rel, node.lineno, node.col_offset, self.rule,
+                    f"`{name.split('.')[-1]}` in device code breaks "
+                    "float32 bit-reproducibility (TPUs emulate f64; "
+                    "keep f64 host-side or split hi/lo — ops/dfloat.py)",
+                    severity, func_line=func_line)
+            return
+        if not isinstance(node, ast.Call):
+            return
+        for kw in node.keywords:
+            if kw.arg != "dtype":
+                continue
+            if isinstance(kw.value, ast.Name) and kw.value.id == "float":
+                yield Finding(
+                    ctx.rel, node.lineno, node.col_offset, self.rule,
+                    "`dtype=float` means float64; name the width "
+                    "explicitly (jnp.float32)", severity,
+                    func_line=func_line)
+            elif (isinstance(kw.value, ast.Constant)
+                  and kw.value.value == "float64"):
+                yield Finding(
+                    ctx.rel, node.lineno, node.col_offset, self.rule,
+                    '`dtype="float64"` in device code breaks float32 '
+                    "bit-reproducibility", severity, func_line=func_line)
+        name = res.call_name(node) or ""
+        first, _, last = name.rpartition(".")
+        if (first in ("jax.numpy", "jnp") and last in _JNP_CONSTRUCTORS
+                and not self._has_dtype(node, res)
+                and any(isinstance(a, ast.Constant)
+                        and isinstance(a.value, float)
+                        for a in node.args)):
+            yield Finding(
+                ctx.rel, node.lineno, node.col_offset, self.rule,
+                f"`{name}` from a bare float literal without an explicit "
+                "dtype follows jax_enable_x64 (f32 today, f64 under the "
+                "flag); pin dtype= for bit-stable output", severity,
+                func_line=func_line)
+
+    @staticmethod
+    def _has_dtype(call, res):
+        if any(kw.arg == "dtype" for kw in call.keywords):
+            return True
+        for arg in call.args:
+            dotted = _dotted(arg)
+            if dotted and dotted.split(".")[-1] in _DTYPE_TOKENS:
+                return True
+        return False
+
+
+# -- PSR105: global mutable state ---------------------------------------------
+
+class GlobalStateChecker:
+    rule = "PSR105"
+
+    def check(self, ctx):
+        severity = RULES[self.rule][0]
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared = set()
+            for node in _walk_no_nested_defs(fn):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared:
+                continue
+            mutated = set()
+            for node in _walk_no_nested_defs(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for tgt in targets:
+                        if (isinstance(tgt, ast.Name)
+                                and tgt.id in declared):
+                            mutated.add(tgt.id)
+            # `global X` + assignment IS module-global mutation whether or
+            # not X also has a module-level initializer
+            for name in sorted(mutated):
+                yield Finding(
+                    ctx.rel, fn.lineno, fn.col_offset, self.rule,
+                    f"`{fn.name}` rebinds module-level `{name}`: "
+                    "process-global state silently couples independent "
+                    "instances (the simulate.py ephemeris bug class); "
+                    "prefer instance state or explicit re-application",
+                    severity, func_line=_func_line(fn))
+
+
+# -- PSR106: sharding axis consistency ----------------------------------------
+
+class ShardingAxesChecker:
+    rule = "PSR106"
+
+    def check(self, ctx):
+        if not ctx.mesh_axes:
+            return
+        res = _resolver_of(ctx)
+        severity = RULES[self.rule][0]
+        func_stack = []
+
+        def visit(node):
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                func_stack.append(node.lineno)
+            yield from self._check_call(ctx, res, node, severity,
+                                        func_stack[-1] if func_stack else 0)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if is_fn:
+                func_stack.pop()
+
+        yield from visit(ctx.tree)
+
+    def _check_call(self, ctx, res, node, severity, func_line):
+        if not isinstance(node, ast.Call):
+            return
+        name = res.call_name(node) or ""
+        last = name.split(".")[-1]
+        if last == "Mesh":       # axis-name tuples here are definitions
+            return
+        if not (last == "PartitionSpec"
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id == "P")):
+            return
+        for arg in node.args:
+            elems = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+            for el in elems:
+                if (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                        and el.value not in ctx.mesh_axes):
+                    yield Finding(
+                        ctx.rel, el.lineno, el.col_offset, self.rule,
+                        f"sharding axis '{el.value}' is not defined "
+                        "by the mesh (known axes: "
+                        f"{sorted(ctx.mesh_axes)}); shard_map would "
+                        "fail at runtime or silently replicate",
+                        severity, func_line=func_line)
+
+
+def default_checkers():
+    return [
+        TraceSafetyChecker(),
+        HostNumpyChecker(),
+        RngReuseChecker(),
+        DtypeChecker(),
+        GlobalStateChecker(),
+        ShardingAxesChecker(),
+    ]

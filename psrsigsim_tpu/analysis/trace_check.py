@@ -1,0 +1,154 @@
+"""Dynamic trace probe: cross-check psrlint's static claims at trace time.
+
+Every public symbol in ``psrsigsim_tpu.ops`` is either (a) traced under
+``jax.make_jaxpr`` + ``jax.eval_shape`` on a canonical small-shape input
+and re-jitted twice to prove a stable cache (retrace count == 1), or
+(b) listed in :data:`EXEMPT` with the reason it is host-side by design.
+A symbol that is neither is a coverage failure — new ops must register a
+probe here the day they are exported (tests/test_psrlint.py enforces
+this).
+
+Why both layers: the AST linter reasons about *source*, so a checker bug
+or an unanticipated idiom can let a trace-unsafe op slip through; the
+probe actually traces each op, so Python branching on tracers, host
+``np.`` round-trips on traced values, and shape-dependent retracing all
+fail here regardless of what the linter thought.  Runs on CPU
+(``JAX_PLATFORMS=cpu``) — tracing is backend-independent.
+"""
+
+from __future__ import annotations
+
+__all__ = ["EXEMPT", "probe_specs", "run_trace_check", "ProbeResult"]
+
+from dataclasses import dataclass
+
+#: public ops symbols that are host-side or non-callable by design
+EXEMPT = {
+    "PchipCoeffs": "interpolant container (NamedTuple), not an op",
+    "chi2_draw_norm": "host-side config helper (scipy ppf at staging time)",
+    "offpulse_window": "host-side float64 reference-parity variant; "
+                       "offpulse_window_jax is the traced twin",
+}
+
+
+@dataclass
+class ProbeResult:
+    name: str
+    status: str       # "ok" | "exempt"
+    detail: str = ""
+
+
+def _specs():
+    """name -> (fn, example_args) with every traced argument a jax array.
+
+    Shapes are tiny: the probe checks TRACEABILITY, not numerics (the
+    tier-1 suite owns numerics).  Static configuration (nchan, nsub,
+    plan geometry, ...) is closed over so only genuinely-traced inputs
+    are abstracted.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ops
+
+    f = jnp.float32
+    key = jax.random.key(0)
+    prof = jnp.asarray(np.cos(np.linspace(0, 2 * np.pi, 64)) + 1.0, f)
+    block = jnp.asarray(np.random.default_rng(0).normal(size=(3, 64)), f)
+    i16 = jnp.asarray(np.arange(96).reshape(4, 3, 8) % 251 - 125, jnp.int16)
+    x8 = jnp.arange(8.0, dtype=f)
+    y8 = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8)), f)
+    coeffs = ops.pchip_fit(x8, y8)
+
+    return {
+        "channelize_power": (lambda d: ops.channelize_power(d, 8),
+                             (jnp.zeros((2, 256), f),)),
+        "fourier_shift": (lambda d, s: ops.fourier_shift(d, s, 0.5),
+                          (block, jnp.arange(3.0, dtype=f))),
+        "coherent_dedispersion_transfer":
+            (lambda dm: ops.coherent_dedispersion_transfer(
+                64, dm, 1400.0, 200.0, 1.0), (jnp.asarray(10.0, f),)),
+        "coherent_dedisperse":
+            (lambda d, dm: ops.coherent_dedisperse(
+                d, dm, 1400.0, 200.0, 1.0), (block, jnp.asarray(10.0, f))),
+        "pchip_slopes": (ops.pchip_slopes, (x8, y8)),
+        "pchip_fit": (ops.pchip_fit, (x8, y8)),
+        "pchip_eval": (ops.pchip_eval, (coeffs, jnp.linspace(0.0, 7.0, 16))),
+        "chi2_sample": (lambda k: ops.chi2_sample(k, 100.0, (32,)), (key,)),
+        "normal_sample": (lambda k: ops.normal_sample(k, (32,)), (key,)),
+        "fftfit_shift": (ops.fftfit_shift, (prof, prof)),
+        "fftfit_batch": (ops.fftfit_batch, (jnp.stack([prof, prof]), prof)),
+        "block_downsample": (lambda d: ops.block_downsample(d, 4), (block,)),
+        "rebin": (lambda d: ops.rebin(d, 16), (block,)),
+        "clip_cast": (lambda b: ops.clip_cast(b, 200.0), (block,)),
+        "subint_quantize": (lambda b: ops.subint_quantize(b, 4, 16),
+                            (block,)),
+        "subint_dequantize": (ops.subint_dequantize,
+                              (i16, jnp.ones((4, 3), f),
+                               jnp.zeros((4, 3), f))),
+        "swap16": (ops.swap16, (i16,)),
+        "fft_convolve_full": (ops.fft_convolve_full, (block, block)),
+        "convolve_profiles": (lambda p, k: ops.convolve_profiles(p, k, 64),
+                              (block, block)),
+        "fold_periods": (lambda d: ops.fold_periods(d, 16), (block,)),
+        "offpulse_window_jax": (ops.offpulse_window_jax, (prof,)),
+        "offpulse_window_indices":
+            (lambda: ops.offpulse_window_indices(64), ()),
+    }
+
+
+def probe_specs():
+    """The probe table (imports jax on first use)."""
+    return _specs()
+
+
+def _check_one(name, fn, args):
+    """Trace, abstract-eval, and retrace-count one op; raises on failure."""
+    import jax
+
+    jax.make_jaxpr(fn)(*args)
+    jax.eval_shape(fn, *args)
+
+    traces = [0]
+
+    def counting(*a):
+        traces[0] += 1
+        return fn(*a)
+
+    jitted = jax.jit(counting)
+    jitted(*args)
+    jitted(*args)
+    if traces[0] != 1:
+        raise AssertionError(
+            f"{name}: traced {traces[0]} times for one call signature — "
+            "something in it depends on concrete values or fresh Python "
+            "identity per call")
+
+
+def run_trace_check(symbols=None):
+    """Probe the given ops symbols (default: all of ``ops.__all__``).
+
+    Returns a list of :class:`ProbeResult`; raises on the first op whose
+    trace fails, and on any public symbol with neither a probe nor an
+    exemption (coverage is part of the contract).
+    """
+    from .. import ops
+
+    names = list(ops.__all__) if symbols is None else list(symbols)
+    specs = probe_specs()
+    missing = [n for n in names if n not in specs and n not in EXEMPT]
+    if missing:
+        raise AssertionError(
+            f"ops symbols with no trace probe and no exemption: {missing} "
+            "— add a canonical-shape entry to analysis/trace_check.py")
+    results = []
+    for name in names:
+        if name in EXEMPT:
+            results.append(ProbeResult(name, "exempt", EXEMPT[name]))
+            continue
+        fn, args = specs[name]
+        _check_one(name, fn, args)
+        results.append(ProbeResult(name, "ok"))
+    return results

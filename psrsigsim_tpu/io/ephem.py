@@ -46,6 +46,7 @@ __all__ = [
     "observatory_itrf", "observatory_ssb", "solve_kepler",
     "OBSERVATORIES", "UnknownObservatoryError", "register_observatory",
     "load_tempo_obsys", "set_ephemeris", "ephemeris_name",
+    "EphemerisChangeWarning",
 ]
 
 # -- constants ---------------------------------------------------------------
@@ -509,7 +510,31 @@ _EPHEM_KERNEL = None   # loaded SPKKernel, or False = explicitly disabled
 _EPHEM_SOURCE = None   # path it was loaded from (for provenance)
 
 
-def set_ephemeris(path):
+def _same_source(a, b):
+    """Whether two source strings name the same kernel FILE — relative
+    vs absolute spellings of one path must neither re-read the kernel
+    nor fire a replacement warning.  The stored ``_EPHEM_SOURCE`` keeps
+    the caller's raw spelling (provenance, spawn-worker state)."""
+    if a is None or b is None:
+        return a == b
+    import os as _os
+
+    return (_os.path.realpath(_os.path.abspath(a))
+            == _os.path.realpath(_os.path.abspath(b)))
+
+
+class EphemerisChangeWarning(UserWarning):
+    """A different SPK kernel replaced the one already active.
+
+    The ephemeris switch is process-global (barycentering has no
+    per-instance state): flipping it while another Simulation's kernel
+    is active silently changes THAT instance's barycentering for every
+    polyco built before it re-applies its own (ADVICE r5 #1).  Resetting
+    to the analytic model (``set_ephemeris(None)``) is the sanctioned
+    cleanup and does not warn."""
+
+
+def set_ephemeris(path, warn=True):
     """Use a JPL SPK kernel (e.g. ``de440s.bsp``) for Earth/Sun
     barycentric positions instead of the built-in analytic series.
 
@@ -517,15 +542,48 @@ def set_ephemeris(path):
     setting ``PSS_EPHEM=<path>`` before first use.  Absolute Roemer
     delays then carry JPL-ephemeris accuracy, matching what the
     reference gets from PINT (psrsigsim/io/psrfits.py:144-177).
+
+    The switch is process-global: replacing a DIFFERENT active kernel
+    emits :class:`EphemerisChangeWarning`, because any object configured
+    against the old kernel now barycenters on the new one until it
+    re-applies its own.  ``warn=False`` is for exactly those sanctioned
+    re-applications (``Simulation``/the bulk exporter restoring their
+    own stamped kernel) — a correct program interleaving two instances
+    must not trip ``-W error`` while repairing the switch.
     """
     global _EPHEM_KERNEL, _EPHEM_SOURCE
     if path is None:
         _EPHEM_KERNEL, _EPHEM_SOURCE = False, None
         return None
+    new_source = str(path)
+    if _EPHEM_KERNEL not in (None, False) and _same_source(_EPHEM_SOURCE,
+                                                           new_source):
+        # idempotent re-application (Simulation re-applies at every
+        # polyco-producing entry point): skip the kernel re-read/re-parse
+        return _EPHEM_KERNEL
+    # reaching here with an active kernel means the source DIFFERS (the
+    # idempotent branch above returned otherwise), so this is the
+    # replacement case — but warn only AFTER the new kernel loads: a bad
+    # path must fail with the old kernel still active and no false
+    # "replaced" message in the log
+    replacing = (warn
+                 and _EPHEM_KERNEL not in (None, False)
+                 and _EPHEM_SOURCE is not None)
+    old_source = _EPHEM_SOURCE
     from .spk import SPKKernel
 
-    _EPHEM_KERNEL = SPKKernel(path)
-    _EPHEM_SOURCE = str(path)
+    kernel = SPKKernel(path)
+    if replacing:
+        import warnings
+
+        warnings.warn(
+            f"set_ephemeris({new_source!r}) replaces the active kernel "
+            f"{old_source!r}; the switch is process-global, so anything "
+            "configured against the old kernel now barycenters on the new "
+            "one until it re-applies its own",
+            EphemerisChangeWarning, stacklevel=2)
+    _EPHEM_KERNEL = kernel
+    _EPHEM_SOURCE = new_source
     return _EPHEM_KERNEL
 
 

@@ -59,7 +59,7 @@ class ExportManifestError(RuntimeError):
 _worker_state = None  # per-process: dict set by _writer_init
 
 
-def _writer_init(payload):
+def _writer_init(payload):  # psrlint: disable=PSR105 (spawn-worker init: per-process state is the point)
     """Spawn-worker initializer: unpickle the shared write context once.
 
     Spawn workers start with fresh module state: an ephemeris the parent
@@ -421,17 +421,27 @@ class _GroupPacker:
         first = g * self.opf
         return first, min(first + self.opf, self.n_obs)
 
-    def add_chunk(self, start, triple):
+    def add_chunk(self, start, triple, skip_group=None):
         """Feed one fetched chunk; yield ``(group_index, packed_triple)``
         for every group the chunk completes.
 
         A group wholly inside the chunk packs as a zero-copy reshape of
         the chunk arrays; only boundary-straddling groups buffer — and
         they buffer per-observation COPIES, so a pending group never pins
-        the whole previous chunk's arrays in memory."""
+        the whole previous chunk's arrays in memory.
+
+        ``skip_group``: optional predicate ``skip_group(g) -> bool``; a
+        True group is neither buffered nor yielded.  The resuming
+        exporter passes its file-exists check here, so a
+        boundary-straddling group whose output already exists never
+        starts a partial buffer that nothing would ever complete
+        (ADVICE r5 #2 — previously such a buffer persisted for the whole
+        export when a sibling group forced one of its chunks to run)."""
         data, scl, offs = (np.asarray(a) for a in triple)
         count = data.shape[0]
         for g in range(start // self.opf, (start + count - 1) // self.opf + 1):
+            if skip_group is not None and skip_group(g):
+                continue
             first, end = self.group_span(g)
             size = end - first
             lo = max(first, start)
@@ -544,12 +554,19 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     # whole chunks of finished work skip the device entirely (a chunk
     # skips only when every file any of its observations feeds exists)
     skip = None
+    skip_group = None
     if resume:
+        # skip_group is THE definition of "this group's file is done";
+        # it feeds the packer so finished straddling groups are never
+        # buffered (ADVICE r5 #2), and the chunk-level predicate derives
+        # from it so a change to resume semantics touches one place
+        def skip_group(g):
+            return os.path.exists(paths[g])
+
         def skip(start, count):
             g_lo = start // obs_per_file
             g_hi = (start + count - 1) // obs_per_file
-            return all(os.path.exists(paths[g])
-                       for g in range(g_lo, g_hi + 1))
+            return all(skip_group(g) for g in range(g_lo, g_hi + 1))
 
     # the writer state carries a shallow COPY of the ensemble's signal
     # shell: packed groups resize its subint geometry and per-obs DMs
@@ -558,6 +575,14 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     import copy as _copy
 
     from . import ephem as _ephem
+
+    # barycenter with the ensemble's OWN kernel (stamped by
+    # Simulation.to_ensemble): another Simulation constructed between
+    # configuration and export may have re-pointed the global switch, and
+    # this is the highest-volume polyco-producing path (ADVICE r5 #1).
+    # Free when already active (set_ephemeris is idempotent).
+    if getattr(ens, "ephemeris_source", None) is not None:
+        _ephem.set_ephemeris(ens.ephemeris_source, warn=False)
 
     state = {"sig": _copy.copy(sig), "pulsar": pulsar, "template": tmpl,
              "parfile": parfile, "MJD_start": MJD_start, "ref_MJD": ref_MJD,
@@ -606,9 +631,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                         _write_obs(state, path,
                                    (data[j], scl[j], offs[j]), dm)
                 continue
-            todo = [(g, packed)
-                    for g, packed in packer.add_chunk(start, (data, scl, offs))
-                    if not (resume and os.path.exists(paths[g]))]
+            todo = list(packer.add_chunk(start, (data, scl, offs),
+                                         skip_group=skip_group))
             if not todo:
                 continue
             if pool is None:

@@ -51,7 +51,7 @@ def _rounded(x):
 _SPLITTER = 4097.0
 
 
-def split_f64(values):
+def split_f64(values):  # psrlint: disable=PSR102,PSR104 (host-side f64 splitter by contract)
     """Host-side: split float64 array into (hi, lo) float32 planes with
     hi + lo == value to ~2^-48 relative."""
     import numpy as np

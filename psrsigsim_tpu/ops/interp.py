@@ -145,7 +145,7 @@ def pchip_eval(coeffs, xq):
 # underflow).  The jax implementation above serves in-graph fitting only.
 
 
-def pchip_fit_np(x, y):
+def pchip_fit_np(x, y):  # psrlint: disable=PSR102,PSR104 (host reference variant)
     """Host float64 PCHIP fit via scipy.
 
     Returns :class:`PchipCoeffs` whose slopes come from the scipy
@@ -161,7 +161,7 @@ def pchip_fit_np(x, y):
     return PchipCoeffs(x=x, y=y, d=slopes)
 
 
-def pchip_eval_np(coeffs, xq):
+def pchip_eval_np(coeffs, xq):  # psrlint: disable=PSR102,PSR104 (host reference variant)
     """Host float64 PCHIP evaluation (scipy), matching :func:`pchip_eval`."""
     from scipy.interpolate import PchipInterpolator
 

@@ -22,7 +22,7 @@ def block_downsample(data, fact):
     return data.reshape(*lead, n // fact, fact).mean(axis=-1)
 
 
-def rebin(data, newlen):
+def rebin(data, newlen):  # psrlint: disable=PSR102 (np on static shapes only: window geometry is a trace-time constant)
     """General rebin of the last axis to ``newlen`` bins by variable-width
     window means.
 

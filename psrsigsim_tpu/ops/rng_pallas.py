@@ -72,7 +72,7 @@ _TWO_PI = float(2.0 * np.pi)
 _PROBE_OK = None
 
 
-def hw_sampler_supported():
+def hw_sampler_supported():  # psrlint: disable=PSR105 (one-shot probe cache, monotonic None->bool)
     """True when the current default backend can run the Mosaic kernels.
 
     Beyond the backend check, the first call actually compiles AND runs a

@@ -237,7 +237,7 @@ class OSPlan(NamedTuple):
     nb: int     # number of blocks
 
 
-def plan_dedisperse_os(nsamp, dm_max, fcent_mhz, bw_mhz, dt_us,
+def plan_dedisperse_os(nsamp, dm_max, fcent_mhz, bw_mhz, dt_us,  # psrlint: disable=PSR102 (host-side planner: static geometry)
                        min_margin=1.5):
     """Plan a pow2-block overlap-save decomposition of a length-``nsamp``
     circular coherent (de)dispersion.
@@ -273,7 +273,7 @@ def plan_dedisperse_os(nsamp, dm_max, fcent_mhz, bw_mhz, dt_us,
         dm_k_s * abs(float(dm_max)) * (f_lo**-2 - f_hi**-2) * 1e6 / dt_us
     )) + 1
 
-    def _pow2(x):
+    def _pow2(x):  # psrlint: disable=PSR102 (host planning arithmetic)
         return 1 << int(np.ceil(np.log2(max(2, x))))
 
     best = None

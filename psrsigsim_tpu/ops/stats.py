@@ -300,7 +300,7 @@ def flat_normal_field(key, f0, length):
     return lax.dynamic_slice(flat, (jnp.asarray(off, jnp.int32),), (length,))
 
 
-def chi2_draw_norm(dtype, df):
+def chi2_draw_norm(dtype, df):  # psrlint: disable=PSR102 (host-side staging helper)
     """Dynamic-range normalization for intensity draws (host-side, static).
 
     float32 signals draw unnormalized with clip ceiling 200; int8 signals are

@@ -38,7 +38,7 @@ def offpulse_window_indices(nphase):
     return jnp.arange(-half, half), half
 
 
-def offpulse_window(max_profile, nphase=None):
+def offpulse_window(max_profile, nphase=None):  # psrlint: disable=PSR102,PSR104 (host-side by contract; offpulse_window_jax is the traced twin)
     """Return the off-pulse window indices ``(2·(ws//2)+1,)`` of a profile.
 
     Finds the circular window of width nphase/8 with minimal trapezoidal

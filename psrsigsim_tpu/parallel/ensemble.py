@@ -75,6 +75,10 @@ class FoldEnsemble:
         # above has already stamped nsub/nsamp/draw_norm onto it
         self._signal = signal
         self._pulsar = pulsar
+        # SPK source the exporter must barycenter with (None = follow the
+        # process-global switch); Simulation.to_ensemble stamps it so a
+        # later Simulation cannot silently swap kernels before export
+        self.ephemeris_source = None
         self.mesh = mesh if mesh is not None else make_mesh()
         self.dm = float(signal.dm.value) if signal.dm is not None else 0.0
 
@@ -193,16 +197,18 @@ class FoldEnsemble:
         )
         return out[:n_obs] if pad else out
 
-    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None,
-                      byte_order="little"):
+    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None):
         """Simulate ``n_obs`` observations and quantize ON DEVICE to PSRFITS
         int16 subints (:func:`~psrsigsim_tpu.ops.subint_quantize`).
-        ``byte_order="big"`` additionally byte-swaps the payload in-graph
-        (see :meth:`iter_chunks`).
 
         Returns ``(data, scl, offs)``: ``(n_obs, nsub, Nchan, nbin)`` int16
         plus ``(n_obs, nsub, Nchan)`` float32 scale/offset columns, with
-        ``physical ≈ data * scl + offs``.  Feed one observation's triple to
+        ``physical ≈ data * scl + offs``.  ``data`` is always value-correct
+        native-endian int16 — the in-graph big-endian byte swap the PSRFITS
+        bulk exporter uses is private to :meth:`iter_chunks`, whose
+        ``byte_order="big"`` output is bit patterns that only mean their
+        values after ``.view('>i2')`` (ADVICE r5 #3: returning that from a
+        value-level API was a footgun).  Feed one observation's triple to
         :meth:`psrsigsim_tpu.io.PSRFITS.save` via ``quantized=`` for an
         export with real DAT_SCL/DAT_OFFS (the reference resets them to 1/0,
         psrsigsim/io/psrfits.py:386-388).
@@ -214,12 +220,8 @@ class FoldEnsemble:
         batch width the backend vectorizes over, which can flip rare codes
         by ±1 (see tests/test_quantize.py).
         """
-        if byte_order not in ("little", "big"):
-            raise ValueError("byte_order must be 'little' or 'big'")
         keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
-        prog = (self._run_sharded_quantized_be if byte_order == "big"
-                else self._run_sharded_quantized)
-        data, scl, offs = prog(
+        data, scl, offs = self._run_sharded_quantized(
             keys, dms, norms, self._profiles, self._freqs, self._chan_ids
         )
         if pad:

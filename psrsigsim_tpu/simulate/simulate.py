@@ -111,12 +111,29 @@ class Simulation:
             # active until changed, and a Simulation constructed WITHOUT
             # ephemeris= uses whatever is globally active.  Applied
             # loudly here so a bad path fails at construction, and
-            # re-applied at save_simulation so another instance cannot
-            # silently swap kernels in between.  The PSRFITS EPHEM card
-            # records the source either way.
+            # re-applied by every polyco-producing entry point
+            # (_activate_ephemeris) so another instance cannot silently
+            # swap kernels in between; set_ephemeris itself warns when
+            # it replaces a different active kernel (ADVICE r5 #1).  The
+            # PSRFITS EPHEM card records the source either way.
+            self._activate_ephemeris(warn=True)
+
+    def _activate_ephemeris(self, warn=False):
+        """Re-apply THIS instance's kernel to the process-global switch.
+
+        Called at construction (``warn=True`` — replacing another
+        instance's active kernel there IS the hazardous cross-coupling
+        :class:`~psrsigsim_tpu.io.ephem.EphemerisChangeWarning` exists
+        for) and again, quietly, at every entry point that produces
+        polycos (``save_simulation``, ``to_ensemble``): restoring our
+        own stamped kernel is the sanctioned repair, not the hazard, and
+        must not trip ``-W error`` suites.  A Simulation built without
+        ``ephemeris=`` deliberately follows whatever is globally active
+        and is left untouched here."""
+        if self._ephemeris is not None:
             from ..io import ephem as _ephem
 
-            _ephem.set_ephemeris(self._ephemeris)
+            _ephem.set_ephemeris(self._ephemeris, warn=warn)
 
     def params_from_dict(self, psrdict):
         """Apply a flat parameter dict (reference: simulate.py:188-193)."""
@@ -280,9 +297,17 @@ class Simulation:
         jitted pipeline, vmapped + mesh-sharded (TPU-native extension)."""
         from ..parallel.ensemble import FoldEnsemble
 
+        # the ensemble's PSRFITS exit path fits polycos: make sure they
+        # barycenter on THIS instance's kernel, not whichever Simulation
+        # touched the global switch last — applied now, and stamped on
+        # the ensemble so export_ensemble_psrfits re-applies it at export
+        # time (another Simulation may run in between)
+        self._activate_ephemeris()
         self.init_all()
-        return FoldEnsemble(self.signal, self.pulsar, self.tscope,
-                            self.system_name, mesh=mesh)
+        ens = FoldEnsemble(self.signal, self.pulsar, self.tscope,
+                           self.system_name, mesh=mesh)
+        ens.ephemeris_source = self._ephemeris
+        return ens
 
     def save_simulation(self, outfile="simfits", out_format="psrfits",
                         parfile=None, ref_MJD=56000.0, MJD_start=55999.9861):
@@ -309,8 +334,7 @@ class Simulation:
             # process-global, and another Simulation may have changed it
             from ..io import ephem as _ephem
 
-            if self._ephemeris is not None:
-                _ephem.set_ephemeris(self._ephemeris)
+            self._activate_ephemeris()
             print("Ephemeris: %s" % _ephem.ephemeris_name())
             pfit.save(self.signal, self.pulsar, parfile=parfile,
                       MJD_start=MJD_start, segLength=60.0, ref_MJD=ref_MJD,

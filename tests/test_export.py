@@ -318,3 +318,89 @@ class TestFastObsWriter:
         for fp, gp in zip(fast_paths, full_paths):
             with open(fp, "rb") as a, open(gp, "rb") as b:
                 assert a.read() == b.read(), fp
+
+
+class TestGroupPackerSkip:
+    """ADVICE r5 #2: a boundary-straddling group whose output file already
+    exists must never be buffered — previously a resume could pin such a
+    partial buffer for the whole export when only a sibling group forced
+    one of its chunks to run."""
+
+    @staticmethod
+    def _triple(start, count, nsub=2, nchan=3, nbin=4):
+        rng = np.random.default_rng(start)
+        return (rng.integers(-100, 100, (count, nsub, nchan, nbin))
+                .astype(np.int16),
+                np.ones((count, nsub, nchan), np.float32),
+                np.zeros((count, nsub, nchan), np.float32))
+
+    def test_skipped_straddling_group_never_buffers(self):
+        from psrsigsim_tpu.io.export import _GroupPacker
+
+        # obs_per_file=2 over 4 obs; chunks of 3 make group 1 straddle
+        # the chunk boundary.  Group 1's file "exists": with the skip
+        # predicate its first half must not start a buffer.
+        packer = _GroupPacker(n_obs=4, obs_per_file=2)
+        done = list(packer.add_chunk(0, self._triple(0, 3),
+                                     skip_group=lambda g: g == 1))
+        assert [g for g, _ in done] == [0]
+        assert packer._buf == {}, "skipped group left a pending buffer"
+        done = list(packer.add_chunk(3, self._triple(3, 1),
+                                     skip_group=lambda g: g == 1))
+        assert done == [] and packer._buf == {}
+
+    def test_skip_predicate_preserves_yielded_bytes(self):
+        from psrsigsim_tpu.io.export import _GroupPacker
+
+        # groups NOT skipped must pack identically with and without the
+        # predicate, including a straddling one (group 1 over chunks)
+        chunks = [(0, self._triple(0, 3)), (3, self._triple(3, 3))]
+        plain_packer = _GroupPacker(6, 2)
+        plain = {g: packed
+                 for start, t in chunks
+                 for g, packed in plain_packer.add_chunk(start, t)}
+        packer = _GroupPacker(6, 2)
+        skipped = {g: packed
+                   for start, t in chunks
+                   for g, packed in packer.add_chunk(
+                       start, t, skip_group=lambda g: g == 0)}
+        assert set(plain) == {0, 1, 2} and set(skipped) == {1, 2}
+        for g in (1, 2):
+            for a, b in zip(plain[g], skipped[g]):
+                np.testing.assert_array_equal(a, b)
+        assert packer._buf == {}
+
+
+class TestExportEphemerisReapply:
+    def test_exporter_reapplies_ensemble_kernel(self, tmp_path, monkeypatch):
+        """ADVICE r5 #1 (bulk path): a Simulation built AFTER the ensemble
+        must not swap the kernel the export barycenters with — the
+        ensemble carries its own source and the exporter re-applies it."""
+        from psrsigsim_tpu.io import ephem, spk
+        from psrsigsim_tpu.parallel.ensemble import FoldEnsemble
+
+        monkeypatch.setattr(spk, "SPKKernel", lambda path: object())
+        d = {
+            "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+            "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+            "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+            "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+            "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+            "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+            "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+        }
+        try:
+            ens = Simulation(ephemeris="a.bsp", psrdict=d).to_ensemble()
+            assert ens.ephemeris_source == "a.bsp"
+            with pytest.warns(ephem.EphemerisChangeWarning):
+                Simulation(ephemeris="b.bsp", psrdict=d)  # swaps the switch
+            assert ephem._EPHEM_SOURCE == "b.bsp"
+            # device work is irrelevant here: stub the chunk stream so the
+            # exporter runs its setup (where the re-apply lives) and exits
+            monkeypatch.setattr(FoldEnsemble, "iter_chunks",
+                                lambda self, *a, **k: iter(()))
+            export_ensemble_psrfits(ens, 2, str(tmp_path / "e"), TEMPLATE,
+                                    ens.pulsar, seed=0, writers=1)
+            assert ephem._EPHEM_SOURCE == "a.bsp"
+        finally:
+            ephem.set_ephemeris(None)

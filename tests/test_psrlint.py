@@ -1,0 +1,457 @@
+"""psrlint: fixture tests per rule, the CI gate, and the trace probe.
+
+Each rule gets at least one positive fixture (the bug pattern MUST be
+flagged) and one negative fixture (the sanctioned idiom MUST NOT be) —
+the negative side is what keeps the linter deployable.  The gate test at
+the bottom is the actual CI wiring: the packaged tree must lint clean
+against analysis/baseline.txt inside the ordinary tier-1 pytest run, and
+every public ops symbol must trace under the dynamic probe.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+import psrsigsim_tpu
+from psrsigsim_tpu.analysis import (
+    EXEMPT,
+    LintConfig,
+    RULES,
+    baseline_regressions,
+    load_baseline,
+    probe_specs,
+    run_lint,
+    run_trace_check,
+)
+from psrsigsim_tpu.analysis.core import _parse_toml_section
+
+PKG_DIR = os.path.dirname(os.path.abspath(psrsigsim_tpu.__file__))
+BASELINE = os.path.join(PKG_DIR, "analysis", "baseline.txt")
+
+# fixtures lint against a fixed config so they do not depend on
+# pyproject.toml contents: fixture modules live under ops/ (device scope)
+FIX_CONFIG = LintConfig(device_modules=("ops/*",), assume_jitted=("ops/*",),
+                        mesh_axes=("obs", "chan"))
+
+
+def lint_src(tmp_path, src, name="ops/fixture.py", config=FIX_CONFIG):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return run_lint(str(tmp_path), config=config)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+class TestTraceSafetyRule:
+    def test_positive_branch_on_traced(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    return y
+                return -y
+        """)
+        assert "PSR101" in rules_of(findings)
+        [f] = [f for f in findings if f.rule == "PSR101"]
+        assert f.line == 8
+
+    def test_positive_transitive_derivation(self, tmp_path):
+        # taint must flow through intermediate assignments regardless of
+        # AST walk order: b is traced because a is
+        findings = lint_src(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                a = jnp.zeros(3) + x
+                b = a + 1
+                if b[0] > 0:
+                    return b
+                return a
+        """)
+        assert "PSR101" in rules_of(findings)
+
+    def test_positive_float_coercion(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return float(jnp.max(x))
+        """)
+        assert "PSR101" in rules_of(findings)
+
+    def test_negative_static_shape_and_none_checks(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, mask=None):
+                y = jnp.asarray(x)
+                if y.shape[-1] == 2:           # static metadata
+                    y = y * 2.0
+                if mask is None:               # identity check
+                    mask = jnp.ones_like(y)
+                if isinstance(x, int):         # type dispatch
+                    return y
+                return y * mask
+        """)
+        assert "PSR101" not in rules_of(findings)
+
+    def test_negative_unreachable_function(self, tmp_path):
+        # a plain host helper (no jit site, no assume_jitted scope) may
+        # branch on anything
+        findings = lint_src(tmp_path, """
+            import jax.numpy as jnp
+
+            def host_helper(x):
+                y = jnp.sum(x)
+                if y > 0:
+                    return y
+                return -y
+        """, name="host/fixture.py")
+        assert "PSR101" not in rules_of(findings)
+
+
+class TestHostNumpyRule:
+    def test_positive_np_in_op(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import numpy as np
+
+            def f(x):
+                return np.fft.rfft(x)
+        """)
+        assert "PSR102" in rules_of(findings)
+
+    def test_negative_concrete_guard_and_allowlist(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import numpy as np
+
+            def _is_concrete(x):
+                return True
+
+            def f(x):
+                nd = np.ndim(x)                 # allowlisted metadata
+                if _is_concrete(x):
+                    return np.fft.rfft(x)       # host branch by contract
+                return x + nd
+        """)
+        assert "PSR102" not in rules_of(findings)
+
+    def test_negative_outside_device_modules(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import numpy as np
+
+            def f(x):
+                return np.fft.rfft(x)
+        """, name="io/fixture.py")
+        assert "PSR102" not in rules_of(findings)
+
+
+class TestRngReuseRule:
+    def test_positive_key_reused_by_two_sinks(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        assert "PSR103" in rules_of(findings)
+        [f] = [f for f in findings if f.rule == "PSR103"]
+        assert f.line == 6
+
+    def test_positive_loop_invariant_key(self, tmp_path):
+        # the same key sampled every iteration draws identical numbers
+        findings = lint_src(tmp_path, """
+            import jax
+
+            def f(key):
+                out = []
+                for _ in range(4):
+                    out.append(jax.random.normal(key, (2,)))
+                return out
+        """)
+        assert "PSR103" in rules_of(findings)
+
+    def test_negative_split_and_fold_in(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax
+
+            def f(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, (3,))
+                b = jax.random.uniform(k2, (3,))
+                return a + b
+
+            def g(root):
+                # repeated DERIVATION from one root is the stage_key idiom
+                ka = jax.random.fold_in(root, 0)
+                kb = jax.random.fold_in(root, 1)
+                return jax.random.normal(ka, ()) + jax.random.normal(kb, ())
+        """)
+        assert "PSR103" not in rules_of(findings)
+
+    def test_negative_exclusive_branches(self, tmp_path):
+        # one sink per control-flow path is fine (ops/stats.py routing)
+        findings = lint_src(tmp_path, """
+            import jax
+
+            def f(key, small):
+                if small:
+                    return jax.random.normal(key, (2,))
+                return jax.random.uniform(key, (2,))
+        """)
+        assert "PSR103" not in rules_of(findings)
+
+
+class TestDtypeRule:
+    def test_positive_float64_and_implicit_dtype(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                y = jnp.asarray(x, jnp.float64)
+                z = jnp.array(1.5)
+                return y + z
+        """)
+        hits = [f for f in findings if f.rule == "PSR104"]
+        assert len(hits) == 2
+        assert {f.line for f in hits} == {5, 6}
+
+    def test_negative_explicit_f32(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                y = jnp.asarray(x, jnp.float32)
+                z = jnp.array(1.5, dtype=jnp.float32)
+                w = jnp.full((3,), 2.5, jnp.float32)
+                return y + z + w
+        """)
+        assert "PSR104" not in rules_of(findings)
+
+
+class TestGlobalStateRule:
+    def test_positive_ephemeris_bug_pattern(self, tmp_path):
+        # the exact shape of the simulate.py:113 / io/ephem.py bug: a
+        # process-global switch rebound from an API entry point
+        findings = lint_src(tmp_path, """
+            _ACTIVE_KERNEL = None
+
+            def set_kernel(path):
+                global _ACTIVE_KERNEL
+                _ACTIVE_KERNEL = path
+        """, name="host/fixture.py")
+        assert "PSR105" in rules_of(findings)
+
+    def test_negative_read_only_global(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            _TABLE = {"a": 1}
+
+            def lookup(k):
+                return _TABLE[k]
+
+            class Holder:
+                def set(self, v):
+                    self.v = v          # instance state is fine
+        """, name="host/fixture.py")
+        assert "PSR105" not in rules_of(findings)
+
+
+class TestShardingAxesRule:
+    def test_positive_phantom_axis(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("obs", "epoch")
+        """, name="parallel/fixture.py")
+        [f] = [f for f in findings if f.rule == "PSR106"]
+        assert "'epoch'" in f.message
+
+    def test_negative_known_axes(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            SPEC = P("obs", "chan")
+            NONE_SPEC = P(None, "chan")
+
+            def build(devs):
+                return Mesh(devs, ("obs", "chan"))   # definitions, not uses
+        """, name="parallel/fixture.py")
+        assert "PSR106" not in rules_of(findings)
+
+
+class TestSuppressionAndBaseline:
+    def test_line_suppression(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                return jnp.asarray(x, jnp.float64)  # psrlint: disable=PSR104
+        """)
+        assert "PSR104" not in rules_of(findings)
+
+    def test_def_line_suppression_covers_body(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import numpy as np
+
+            def host_fn(x):  # psrlint: disable=PSR102
+                a = np.fft.rfft(x)
+                return np.fft.irfft(a)
+        """)
+        assert "PSR102" not in rules_of(findings)
+
+    def test_baseline_is_a_ratchet(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            import jax.numpy as jnp
+
+            def f(x):
+                return jnp.asarray(x, jnp.float64)
+
+            def g(x):
+                return jnp.asarray(x, jnp.float64)
+        """)
+        hits = [f for f in findings if f.rule == "PSR104"]
+        assert len(hits) == 2
+        key = ("PSR104", "ops/fixture.py")
+        assert baseline_regressions(hits, {key: 2}) == []       # covered
+        assert len(baseline_regressions(hits, {key: 1})) == 2   # regressed
+        assert len(baseline_regressions(hits, {})) == 2
+
+    def test_toml_section_parser(self):
+        cfg = _parse_toml_section(
+            '[tool.other]\nx = 1\n[tool.psrlint]\n'
+            'include = ["*.py", "b.py"]\nbaseline = "b.txt"\n[tool.next]\n'
+            'include = ["nope"]\n', "tool.psrlint")
+        assert cfg == {"include": ["*.py", "b.py"], "baseline": "b.txt"}
+
+    def test_toml_parser_multiline_arrays(self):
+        # toml formatters spread arrays across lines; mis-parsing one as
+        # a scalar once disabled the whole gate (include == "[")
+        cfg = _parse_toml_section(
+            '[tool.psrlint]\ninclude = [\n  "*.py",\n  "b.py",\n]\n'
+            'exclude = ["x/*"]\n', "tool.psrlint")
+        assert cfg == {"include": ["*.py", "b.py"], "exclude": ["x/*"]}
+
+    def test_subpath_scan_keeps_package_relative_paths(self):
+        # pointing the linter at a SUB-path must produce the same rel
+        # paths (and thus the same rule scoping and baseline keys) as a
+        # whole-package scan — device rules once silently vanished when
+        # scanning psrsigsim_tpu/models directly
+        sub = run_lint(os.path.join(PKG_DIR, "models"))
+        full = [f for f in run_lint(PKG_DIR)
+                if f.path.startswith("models/")]
+        assert [f.sort_key() for f in sub] == [f.sort_key() for f in full]
+        assert any(f.rule == "PSR104" for f in sub)
+        one = run_lint(os.path.join(PKG_DIR, "io", "ephem.py"))
+        assert {f.path for f in one} == {"io/ephem.py"}
+
+
+class TestPackageGate:
+    """The actual CI gate, collected by the ordinary tier-1 run."""
+
+    def test_package_lints_clean_against_baseline(self):
+        findings = run_lint(PKG_DIR)
+        regressions = baseline_regressions(findings, load_baseline(BASELINE))
+        assert regressions == [], (
+            "psrlint regressions (fix, suppress inline with a reason, or "
+            "consciously ratchet via python -m psrsigsim_tpu.analysis "
+            "--write-baseline):\n"
+            + "\n".join(f.format() for f in regressions))
+
+    def test_gate_identical_with_defaults_only(self):
+        # a pip-installed package has no pyproject.toml on its ancestor
+        # chain: the dataclass defaults must mirror [tool.psrlint] so the
+        # gate behaves identically there
+        from psrsigsim_tpu.analysis import load_config
+
+        with_config = run_lint(PKG_DIR, config=load_config(PKG_DIR))
+        defaults_only = run_lint(PKG_DIR, config=LintConfig())
+        assert ([f.sort_key() for f in defaults_only]
+                == [f.sort_key() for f in with_config])
+
+    def test_every_rule_id_documented(self):
+        doc = os.path.join(os.path.dirname(PKG_DIR), "docs",
+                           "static_analysis.md")
+        with open(doc) as f:
+            text = f.read()
+        for rule in RULES:
+            assert rule in text, f"{rule} missing from docs/static_analysis.md"
+
+    def test_cli_entry_point(self, capsys):
+        from psrsigsim_tpu.analysis.__main__ import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+        assert main([PKG_DIR, "-q"]) == 0
+
+    def test_overlapping_paths_lint_once(self, capsys):
+        # `psrlint pkg pkg/models` must not double-count models/* findings
+        # into phantom baseline regressions
+        from psrsigsim_tpu.analysis.__main__ import main
+
+        assert main([PKG_DIR, os.path.join(PKG_DIR, "models"), "-q"]) == 0
+
+    def test_single_file_honors_exclude_globs(self):
+        # analysis/* is excluded in [tool.psrlint]; pointing the linter at
+        # one of its files directly must not lint it through the side door
+        from psrsigsim_tpu.analysis import load_config
+
+        target = os.path.join(PKG_DIR, "analysis", "checkers.py")
+        assert run_lint(target, config=load_config(target)) == []
+
+    def test_subpath_write_baseline_preserves_out_of_scope(self, tmp_path,
+                                                           capsys):
+        # --write-baseline on a sub-path must not discard ratchet entries
+        # for files it did not lint
+        from psrsigsim_tpu.analysis.__main__ import main
+
+        bl = tmp_path / "bl.txt"
+        assert main([PKG_DIR, "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        full = load_baseline(str(bl))
+        assert main([os.path.join(PKG_DIR, "models"), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+        assert load_baseline(str(bl)) == full
+        # and the full gate still passes against the rewritten file
+        assert main([PKG_DIR, "--baseline", str(bl), "-q"]) == 0
+
+
+class TestTraceProbe:
+    def test_probe_covers_every_public_op(self):
+        from psrsigsim_tpu import ops
+
+        specs = probe_specs()
+        uncovered = [n for n in ops.__all__
+                     if n not in specs and n not in EXEMPT]
+        assert uncovered == [], (
+            f"public ops with no trace probe and no exemption: {uncovered}")
+        # exemptions must not rot: every entry names a live public symbol
+        stale = [n for n in EXEMPT if n not in ops.__all__]
+        assert stale == []
+
+    def test_all_ops_trace_clean(self):
+        from psrsigsim_tpu import ops
+
+        results = run_trace_check()
+        assert len(results) == len(ops.__all__)
+        assert all(r.status in ("ok", "exempt") for r in results)
+
+    def test_probe_rejects_uncovered_symbol(self):
+        with pytest.raises(AssertionError, match="no trace probe"):
+            run_trace_check(["definitely_not_an_op"])

@@ -106,17 +106,27 @@ class TestEnsembleQuantized:
         assert offs.shape == (3, nsub, nchan)
 
     def test_big_endian_path_matches_little(self):
-        # byte_order="big" must change bit patterns only: viewing the
-        # payload as '>i2' recovers exactly the little-endian values,
-        # and scl/offs are untouched
+        # byte_order="big" is private to iter_chunks (the exporter's
+        # transport encoding) and must change bit patterns only: viewing
+        # the payload as '>i2' recovers exactly the values run_quantized
+        # returns, and scl/offs are untouched
         ens, _, _ = _ensemble()
         d_le, s_le, o_le = ens.run_quantized(n_obs=2, seed=5)
-        d_be, s_be, o_be = ens.run_quantized(n_obs=2, seed=5,
-                                             byte_order="big")
+        [(start, (d_be, s_be, o_be))] = list(ens.iter_chunks(
+            2, chunk_size=2, seed=5, quantized=True, byte_order="big"))
+        assert start == 0
         np.testing.assert_array_equal(
             np.asarray(d_be).view(">i2").astype(np.int16), np.asarray(d_le))
         np.testing.assert_array_equal(np.asarray(s_be), np.asarray(s_le))
         np.testing.assert_array_equal(np.asarray(o_be), np.asarray(o_le))
+
+    def test_run_quantized_has_no_byte_order_switch(self):
+        # ADVICE r5 #3: run_quantized once accepted byte_order="big" and
+        # returned garbled-unless-viewed values; the parameter is gone
+        # from the value-level API for good
+        ens, _, _ = _ensemble()
+        with pytest.raises(TypeError):
+            ens.run_quantized(n_obs=1, seed=0, byte_order="big")
 
     def test_matches_float_pipeline(self):
         # quantizing the float ensemble output on host must reproduce the

@@ -360,3 +360,67 @@ class TestReviewRegressions:
         data = ens.run(n_obs=1, seed=7)
         assert data.shape[0] == 1
         assert np.isfinite(np.asarray(data)).all()
+
+
+class TestEphemerisDiscipline:
+    """ADVICE r5 #1: the ephemeris switch is process-global; replacing a
+    different active kernel must warn, and a Simulation must re-apply its
+    own kernel at every polyco-producing entry point."""
+
+    @pytest.fixture(autouse=True)
+    def _fake_kernels(self, monkeypatch):
+        from psrsigsim_tpu.io import ephem, spk
+
+        monkeypatch.setattr(spk, "SPKKernel", lambda path: object())
+        yield
+        ephem.set_ephemeris(None)
+
+    def test_overwrite_warns(self):
+        from psrsigsim_tpu.io import ephem
+
+        ephem.set_ephemeris("a.bsp")
+        with pytest.warns(ephem.EphemerisChangeWarning, match="a.bsp"):
+            ephem.set_ephemeris("b.bsp")
+
+    def test_same_source_and_reset_do_not_warn(self, recwarn):
+        import os
+        import warnings
+
+        from psrsigsim_tpu.io import ephem
+
+        ephem.set_ephemeris("a.bsp")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ephem.EphemerisChangeWarning)
+            ephem.set_ephemeris("a.bsp")   # idempotent re-apply
+            # a different SPELLING of the same file is the same source
+            ephem.set_ephemeris(os.path.abspath("a.bsp"))
+            assert ephem._EPHEM_SOURCE == "a.bsp"   # raw spelling kept
+            ephem.set_ephemeris(None)      # sanctioned cleanup
+            ephem.set_ephemeris("a.bsp")   # activate from analytic
+
+    def test_instance_kernel_reapplied(self):
+        import warnings
+
+        from psrsigsim_tpu.io import ephem
+
+        sim_a = Simulation(ephemeris="a.bsp")
+        assert ephem._EPHEM_SOURCE == "a.bsp"
+        # another instance swaps the global switch: the hazardous case
+        with pytest.warns(ephem.EphemerisChangeWarning):
+            Simulation(ephemeris="b.bsp")
+        assert ephem._EPHEM_SOURCE == "b.bsp"
+        # ...and every polyco-producing entry point of A re-applies A's
+        # QUIETLY — restoring our own kernel is the repair, not the
+        # hazard, and must survive -W error suites
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ephem.EphemerisChangeWarning)
+            sim_a._activate_ephemeris()
+        assert ephem._EPHEM_SOURCE == "a.bsp"
+
+    def test_no_ephemeris_instance_leaves_switch_alone(self):
+        from psrsigsim_tpu.io import ephem
+
+        ephem.set_ephemeris("a.bsp")
+        sim = Simulation()
+        sim._activate_ephemeris()
+        assert ephem._EPHEM_SOURCE == "a.bsp"
