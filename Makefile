@@ -1,10 +1,15 @@
 # Developer entry points.  CI needs no extra plumbing: `make lint` is also
-# collected by the ordinary pytest run (tests/test_psrlint.py).
+# collected by the ordinary pytest run (tests/test_psrlint.py), and the
+# fault-injection suite carries the `faults` marker, so it runs inside
+# tier-1 (`make test`) AND is addressable on its own (`make test-faults`).
 
-.PHONY: lint test
+.PHONY: lint test test-faults
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+test-faults:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults

@@ -729,7 +729,7 @@ def time_export_e2e(n_obs=None):
                     lambda j: _stage_key(jax.random.fold_in(root, i),
                                          "user", j)
                 )(idxq)
-                d, sc, of = ens._run_sharded_quantized(
+                d, sc, of, _ = ens._run_sharded_quantized(
                     keys, dms_q, norms_q, ens._profiles, ens._freqs,
                     ens._chan_ids)
                 return (accs[0] + d, accs[1] + sc, accs[2] + of)
@@ -753,7 +753,8 @@ def time_export_e2e(n_obs=None):
         # iter_chunks does: prepped inputs into the BE-swapped program.
         keys_q, dms_c, norms_c, pad_q = ens._prep_inputs(chunk, 4, None, None)
         dev = ens._run_sharded_quantized_be(
-            keys_q, dms_c, norms_c, ens._profiles, ens._freqs, ens._chan_ids)
+            keys_q, dms_c, norms_c, ens._profiles, ens._freqs,
+            ens._chan_ids)[:3]   # drop the finite-mask guard output
         if pad_q:
             dev = tuple(a[:chunk] for a in dev)
         jax.block_until_ready(dev)

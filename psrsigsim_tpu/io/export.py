@@ -39,6 +39,8 @@ import pickle
 
 import numpy as np
 
+from ..runtime.faults import crash_process, should_fire
+from ..runtime.retry import RetriesExhausted, RetryPolicy, call_with_retry
 from ..utils.quantity import make_quant
 from .fits import FitsFile
 from .psrfits import PSRFITS
@@ -47,9 +49,49 @@ __all__ = ["export_ensemble_psrfits", "ExportManifestError"]
 
 _MANIFEST_NAME = "export_manifest.json"
 
+# operator-facing hints for manifest fingerprint fields: a mismatch on a
+# content hash usually means a stale out_dir from an older run; a mismatch
+# on a scalar usually means a config typo in THIS invocation
+_FINGERPRINT_HINTS = {
+    "n_obs": "ensemble size differs (config typo, or out_dir from a "
+             "differently sized run)",
+    "seed": "RNG seed differs — same out_dir, different ensemble",
+    "dms_sha256": "per-observation DM array content differs",
+    "noise_norms_sha256": "per-observation noise-norm array content differs",
+    "template_sha256": "PSRFITS template file CONTENT differs (swapped or "
+                       "edited template)",
+    "parfile": "par file name differs",
+    "MJD_start": "start epoch differs",
+    "ref_MJD": "polyco reference epoch differs",
+    "obs_per_file": "file packing differs — files would interleave "
+                    "incompatibly",
+}
+
 
 class ExportManifestError(RuntimeError):
-    """resume=True against an out_dir written with different parameters."""
+    """resume=True against an out_dir written with different parameters.
+
+    Carries the exact disagreement so operators can tell a stale out_dir
+    from a config typo without diffing JSON by hand: :attr:`mismatches`
+    maps each differing fingerprint field to ``(found_in_out_dir,
+    expected_by_this_run)``; the message renders one line per field with
+    the field-specific hint from ``_FINGERPRINT_HINTS``.
+    """
+
+    def __init__(self, out_dir, mismatches):
+        self.out_dir = out_dir
+        self.mismatches = dict(mismatches)
+        lines = []
+        for field in sorted(self.mismatches):
+            found, expected = self.mismatches[field]
+            hint = _FINGERPRINT_HINTS.get(field, "parameter differs")
+            lines.append(f"  - {field}: out_dir has {found!r}, this run "
+                         f"has {expected!r}  [{hint}]")
+        super().__init__(
+            f"out_dir {out_dir} holds an export with different parameters; "
+            "resuming would silently mix two ensembles.  Differing "
+            "fingerprint fields:\n" + "\n".join(lines) +
+            "\nUse a fresh out_dir, or resume=False to overwrite.")
 
 
 # ---------------------------------------------------------------------------
@@ -76,10 +118,12 @@ def _writer_init(payload):  # psrlint: disable=PSR105 (spawn-worker init: per-pr
         ephem.set_ephemeris(src)
 
 
-def _attach_chunk(shm_name, meta):
+def _attach_chunk(shm_name, meta, faults=None):
     """Reconstruct the (data, scl, offs) views from a shared-memory block."""
     from multiprocessing import shared_memory
 
+    if should_fire(faults, "shm.attach", shm_name):
+        raise OSError(f"injected shm-attach failure for {shm_name}")
     shm = shared_memory.SharedMemory(name=shm_name)
     arrays = []
     off = 0
@@ -143,17 +187,20 @@ class _FastObsWriter:
         self._protos = {}
 
     def write(self, path, triple, dm):
+        """Write one file; returns its sha256 when the state records
+        hashes AND the fast path had the payload in memory (None
+        otherwise — the caller falls back to hashing the file)."""
         if dm is not None:
             # per-observation DMs patch headers too: keep the one full
             # pipeline as the single source of truth for that rare path
             _write_obs_full(self._state, path, triple, dm)
-            return
+            return None
         shape = tuple(np.asarray(triple[0]).shape)
         proto = self._protos.get(shape)
         if proto is None:
             _write_obs_full(self._state, path, triple, dm)
             self._protos[shape] = self._init_proto(path)
-            return
+            return None
         pre, sub, post, pad = proto
         q_data, q_scl, q_offs = (np.asarray(a) for a in triple)
         arr = sub.data
@@ -176,6 +223,17 @@ class _FastObsWriter:
         tmp = path + ".tmp"
         bufs = [pre, arr.view(np.uint8).reshape(-1), pad, post]
         total = sum(len(b) for b in bufs)
+        if should_fire(self._state.get("faults"), "file.partial", path):
+            # model a power-cut/SIGKILL mid-write: half the payload lands
+            # in the temp file, then the writing process dies without
+            # Python teardown — the .tmp must never be mistaken for a
+            # finished file by resume (finished files are renamed)
+            with open(tmp, "wb") as f:
+                blob = b"".join(bufs)
+                f.write(blob[: len(blob) // 2])
+                f.flush()
+                os.fsync(f.fileno())
+            crash_process()
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
         try:
             # one gathered syscall; the array's raw buffer is the FITS
@@ -192,6 +250,14 @@ class _FastObsWriter:
             raise
         os.close(fd)
         os.replace(tmp, path)
+        if self._state.get("hash_files"):
+            # the bufs ARE the file bytes just written: hash them in
+            # memory instead of re-reading a multi-GB run back from disk
+            h = hashlib.sha256()
+            for b in bufs:
+                h.update(b)
+            return h.hexdigest()
+        return None
 
     def _init_proto(self, path):
         from .fits import BLOCK
@@ -217,13 +283,51 @@ class _FastObsWriter:
         return (pre, sub, post, pad)
 
 
+def _file_sha(path):
+    """Streaming sha256 of a finished output file (the manifest/verify
+    fingerprint of crash-safe resume)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
 def _write_obs(state, path, triple, dm):
     """Write ONE observation (serial and worker paths): fast prototype
-    writer once primed, full pipeline otherwise."""
+    writer once primed, full pipeline otherwise.  Returns the file's
+    sha256 when the run records hashes (supervised exports), else None —
+    computed from the in-memory payload on the fast path, read back from
+    disk only for the rare full-pipeline writes."""
     writer = state.get("_fast_writer")
     if writer is None:
         writer = state["_fast_writer"] = _FastObsWriter(state)
-    writer.write(path, triple, dm)
+    sha = writer.write(path, triple, dm)
+    if state.get("hash_files"):
+        return sha if sha is not None else _file_sha(path)
+    return None
+
+
+def _serial_write_jobs(state, arrays, jobs):
+    """In-process write of a job batch straight from host arrays (the
+    degraded/no-pool path).  Returns ``[(path, sha_or_None), ...]``."""
+    data, scl, offs = arrays
+    out = []
+    for j, path, dm in jobs:
+        sha = _write_obs(state, path, (data[j], scl[j], offs[j]), dm)
+        out.append((path, sha))
+    return out
+
+
+def _serial_write_from_shm(state, shm_name, meta, jobs):
+    """In-process write of a job batch out of a shared-memory chunk — how
+    a degraded pool finishes work its dead workers left behind."""
+    shm, arrays = _attach_chunk(shm_name, meta)
+    try:
+        return _serial_write_jobs(state, arrays, jobs)
+    finally:
+        del arrays
+        shm.close()
 
 
 def _probe():
@@ -235,90 +339,344 @@ def _probe():
 
 def _worker_write(shm_name, meta, jobs):
     """Write a batch of observations out of one shared-memory chunk.
-    ``jobs`` is a list of (local_index, path, dm_or_None)."""
-    shm, (data, scl, offs) = _attach_chunk(shm_name, meta)
+    ``jobs`` is a list of (local_index, path, dm_or_None); returns
+    ``[(path, sha_or_None), ...]`` so the parent can journal hashes."""
+    faults = _worker_state.get("faults")
+    shm, (data, scl, offs) = _attach_chunk(shm_name, meta, faults=faults)
+    out = []
     try:
         for j, path, dm in jobs:
-            _write_obs(_worker_state, path, (data[j], scl[j], offs[j]), dm)
+            if should_fire(faults, "writer.crash", path):
+                # the fault being modeled is an OOM-killed / preempted
+                # writer process: die hard, mid-batch, no cleanup
+                crash_process()
+            sha = _write_obs(_worker_state, path,
+                             (data[j], scl[j], offs[j]), dm)
+            out.append((path, sha))
     finally:
         del data, scl, offs
         shm.close()
-    return len(jobs)
+    return out
 
 
 class _WriterPool:
-    """Fan observation writes out to spawn workers through shared memory.
+    """Fan observation writes out to spawn workers through shared memory —
+    and survive those workers dying.
 
     One SHM block per chunk (a single memcpy from the fetched host arrays),
     jobs round-robined across workers in contiguous slices, and a
     two-chunk window so writes overlap the next chunk's transfer without
     holding unbounded host memory.
+
+    Self-healing (the 10k-observation run must outlive its workers):
+
+    - A dead worker breaks the whole ``ProcessPoolExecutor``; the pool
+      detects it (``BrokenExecutor`` on drain), re-spawns a fresh executor
+      under the capped-exponential-backoff :class:`RetryPolicy`, and
+      resubmits every not-yet-drained batch — output files are written
+      atomically, so re-running a half-finished batch is idempotent.
+    - Plain job failures (an exception out of a live worker — e.g. a
+      transient shm attach error) retry the one batch up to
+      ``job_retries`` times before surfacing.
+    - After ``max_pool_deaths`` CONSECUTIVE pool deaths (the counter
+      resets on any drained batch) the pool degrades to an in-process
+      serial writer instead of aborting the run: queued shm batches are
+      finished by the parent, and later ``submit_chunk`` calls write
+      synchronously.  Slower beats dead.
+    - Every exit path — success, job failure, pool death, degradation —
+      closes AND unlinks the chunk's shared-memory segment in ``finally``
+      blocks; a multi-hour run must not bleed /dev/shm.
+
+    ``on_chunk_done(token, results)`` fires after a chunk's writes are
+    durably complete (the run supervisor journals there); drains are FIFO
+    so commit order follows submit order.
     """
 
-    def __init__(self, n_writers, payload, startup_timeout=120.0):
+    def __init__(self, n_writers, payload, state, startup_timeout=120.0,
+                 respawn_policy=None, max_pool_deaths=3, job_retries=2,
+                 on_chunk_done=None):
+        self.n = n_writers
+        self._payload = payload
+        self._state = state  # parent-side writer state for serial fallback
+        self._timeout = startup_timeout
+        self._policy = respawn_policy or RetryPolicy(
+            max_attempts=3, base_delay=0.25, max_delay=5.0)
+        self._max_pool_deaths = int(max_pool_deaths)
+        self._job_retries = int(job_retries)
+        self._on_chunk_done = on_chunk_done
+        self._deaths = 0      # consecutive pool deaths (resets on progress)
+        self.degraded = False
+        self._pool = None
+        self._inflight = []   # [{shm, meta, pending: [{jobs, fut, tries}], token}]
+        self._spawn_pool()    # raises if workers cannot start at all
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn_pool(self):
         import concurrent.futures as cf
         import multiprocessing as mp
 
         ctx = mp.get_context("spawn")  # fork after JAX init is unsafe
-        self._pool = cf.ProcessPoolExecutor(
-            max_workers=n_writers, mp_context=ctx,
-            initializer=_writer_init, initargs=(payload,))
-        self.n = n_writers
-        self._inflight = []  # [(shm, futures)]
+        pool = cf.ProcessPoolExecutor(
+            max_workers=self.n, mp_context=ctx,
+            initializer=_writer_init, initargs=(self._payload,))
         # fail fast if workers cannot start at all (e.g. __main__ not
         # importable under spawn) instead of hanging on the first drain
         try:
-            self._pool.submit(_probe).result(timeout=startup_timeout)
+            pool.submit(_probe).result(timeout=self._timeout)
         except BaseException:
-            self._pool.shutdown(wait=False, cancel_futures=True)
+            pool.shutdown(wait=False, cancel_futures=True)
             raise
+        self._pool = pool
 
-    def submit_chunk(self, triple, jobs):
+    def _shutdown_pool(self, wait=True):
+        if self._pool is not None:
+            self._pool.shutdown(wait=wait, cancel_futures=not wait)
+            self._pool = None
+
+    def _degrade(self, err):
+        import warnings
+
+        self.degraded = True
+        self._shutdown_pool(wait=False)
+        warnings.warn(
+            f"writer pool died {self._deaths} consecutive time(s) "
+            f"(last: {err!r}); degrading to the in-process serial writer "
+            "for the rest of the export", RuntimeWarning)
+
+    def _try_respawn(self):
+        """Replace a dead executor under the backoff policy.  False means
+        respawn itself keeps failing — callers degrade."""
+        import warnings
+
+        self._shutdown_pool(wait=False)
+        try:
+            call_with_retry(
+                self._spawn_pool, self._policy,
+                on_retry=lambda k, e, d: warnings.warn(
+                    f"writer-pool respawn attempt {k + 1} failed ({e!r}); "
+                    f"retrying in {d:.2f}s", RuntimeWarning))
+            return True
+        except RetriesExhausted:
+            return False
+
+    def _handle_pool_death(self, err, entry=None):
+        """One consecutive pool death: respawn under the backoff policy
+        and resubmit every broken future, or degrade once the streak (or
+        the respawn budget) is spent.  Callers continue their loop either
+        way — the degraded flag redirects remaining work to the serial
+        writer."""
+        self._deaths += 1
+        if self._deaths >= self._max_pool_deaths or not self._try_respawn():
+            self._degrade(err)
+            return
+        import warnings
+
+        warnings.warn(
+            f"writer pool died ({err!r}); respawned (consecutive death "
+            f"{self._deaths}/{self._max_pool_deaths}) and resubmitted "
+            "pending batches", RuntimeWarning)
+        self._resubmit_all(entry)
+
+    def _resubmit_all(self, entry=None):
+        """After a respawn every broken future — in ``entry`` (if given)
+        and in every in-flight chunk — must be re-queued on the new
+        executor.  Batches that already FINISHED on the dead executor
+        keep their results (harvested into ``done_result``) instead of
+        being rewritten — one worker death must not double the window's
+        I/O.  A pool that dies again DURING resubmission degrades (the
+        fresh-spawned probe passed, so workers are dying faster than
+        they start — respawning again would spin)."""
+        from concurrent.futures import BrokenExecutor
+
+        entries = ([entry] if entry is not None else []) + self._inflight
+        try:
+            for e in entries:
+                for item in e["pending"]:
+                    if "done_result" in item:
+                        continue
+                    fut = item["fut"]
+                    if fut.done():
+                        try:
+                            item["done_result"] = fut.result()
+                            continue
+                        except BaseException:  # noqa: BLE001 — broken or
+                            pass               # cancelled: resubmit below
+                    item["fut"] = self._pool.submit(
+                        _worker_write, e["shm"].name, e["meta"],
+                        item["jobs"])
+        except BrokenExecutor as err:
+            self._degrade(err)
+
+    # -- submission / drain ------------------------------------------------
+
+    def submit_chunk(self, triple, jobs, token=None):
+        from concurrent.futures import BrokenExecutor
         from multiprocessing import shared_memory
 
+        if self.degraded:
+            # drain older chunks FIRST: their segments must not pin
+            # /dev/shm for the rest of the run, and journal commits must
+            # keep following submit order (the degraded _collect path
+            # writes them serially out of their shm blocks)
+            while self._inflight:
+                self._drain_oldest()
+            arrays = tuple(np.ascontiguousarray(a) for a in triple)
+            self._notify(token, _serial_write_jobs(self._state, arrays, jobs))
+            return
         data, scl, offs = (np.ascontiguousarray(a) for a in triple)
         nbytes = data.nbytes + scl.nbytes + offs.nbytes
         shm = shared_memory.SharedMemory(create=True, size=max(nbytes, 1))
-        off = 0
-        meta = []
-        for a in (data, scl, offs):
-            # single memcpy straight into the shared block (no bytes temp)
-            view = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf,
-                              offset=off)
-            view[...] = a
-            meta.append((a.shape, a.dtype.str))
-            off += a.nbytes
-            del view
-        futures = []
-        step = max(1, -(-len(jobs) // self.n))
-        for k in range(0, len(jobs), step):
-            futures.append(self._pool.submit(
-                _worker_write, shm.name, meta, jobs[k:k + step]))
-        self._inflight.append((shm, futures))
+        try:
+            off = 0
+            meta = []
+            for a in (data, scl, offs):
+                # single memcpy straight into the shared block
+                view = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf,
+                                  offset=off)
+                view[...] = a
+                meta.append((a.shape, a.dtype.str))
+                off += a.nbytes
+                del view
+            step = max(1, -(-len(jobs) // self.n))
+            batches = [jobs[k:k + step] for k in range(0, len(jobs), step)]
+            while True:
+                # a worker can die while the pool is idle between chunks:
+                # the death then surfaces HERE (submit raises
+                # BrokenExecutor), and must enter the same
+                # respawn/degrade ladder as a death caught at drain
+                try:
+                    pending = [
+                        {"jobs": batch, "tries": 0,
+                         "fut": self._pool.submit(_worker_write, shm.name,
+                                                  meta, batch)}
+                        for batch in batches]
+                    break
+                except BrokenExecutor as err:
+                    self._handle_pool_death(err)
+                    if self.degraded:
+                        break
+            if self.degraded:
+                while self._inflight:
+                    self._drain_oldest()
+                results = _serial_write_jobs(self._state, (data, scl, offs),
+                                             jobs)
+                shm.close()
+                shm.unlink()
+                self._notify(token, results)
+                return
+        except BaseException:
+            # submission failed mid-way: this chunk's segment would never
+            # reach a drain, so release it here (satellite: unlink on
+            # EVERY exit path).  The degraded branch above already
+            # unlinked before its commit notification — a second unlink
+            # must not shadow the real error with FileNotFoundError
+            try:
+                shm.close()
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+            raise
+        self._inflight.append({"shm": shm, "meta": meta, "pending": pending,
+                               "token": token})
         if len(self._inflight) > 1:
             self._drain_oldest()
 
     def _drain_oldest(self):
-        shm, futures = self._inflight.pop(0)
+        entry = self._inflight.pop(0)
+        shm = entry["shm"]
         try:
-            for f in futures:
-                f.result()
+            results = self._collect(entry)
         finally:
-            shm.close()
-            shm.unlink()
+            # unconditional release: whatever _collect raised, this
+            # chunk's segment is dead to us now
+            try:
+                shm.close()
+            finally:
+                try:
+                    shm.unlink()
+                except FileNotFoundError:  # pragma: no cover - already gone
+                    pass
+        self._notify(entry["token"], results)
+
+    def _collect(self, entry):
+        from concurrent.futures import BrokenExecutor
+
+        results = []
+        pending = entry["pending"]
+        while pending:
+            if self.degraded:
+                # a prior chunk already tripped degradation: the executor
+                # is gone, finish this chunk's remainder in-process
+                # (batches harvested before the death keep their results)
+                for item in pending:
+                    if "done_result" in item:
+                        results.extend(item["done_result"])
+                    else:
+                        results.extend(_serial_write_from_shm(
+                            self._state, entry["shm"].name, entry["meta"],
+                            item["jobs"]))
+                del pending[:]
+                break
+            item = pending[0]
+            if "done_result" in item:
+                # finished on an executor that later died; the writes are
+                # on disk — keep them (no deaths-streak reset: this is
+                # pre-death progress, not evidence the new pool works)
+                results.extend(item["done_result"])
+                pending.pop(0)
+                continue
+            try:
+                results.extend(item["fut"].result())
+            except BrokenExecutor as err:
+                self._handle_pool_death(err, entry)
+                continue
+            except Exception as err:
+                item["tries"] += 1
+                if item["tries"] > self._job_retries:
+                    raise
+                import warnings
+
+                warnings.warn(
+                    f"writer job batch failed ({err!r}); retry "
+                    f"{item['tries']}/{self._job_retries}", RuntimeWarning)
+                try:
+                    item["fut"] = self._pool.submit(
+                        _worker_write, entry["shm"].name, entry["meta"],
+                        item["jobs"])
+                except BrokenExecutor as err2:
+                    # the pool died between the job failure and its
+                    # retry: same ladder as a death caught at drain
+                    self._handle_pool_death(err2, entry)
+                continue
+            pending.pop(0)
+            self._deaths = 0  # forward progress resets the death streak
+        return results
+
+    def _notify(self, token, results):
+        if self._on_chunk_done is not None and token is not None:
+            self._on_chunk_done(token, results)
+
+    # -- teardown ----------------------------------------------------------
 
     def finish(self):
         """Drain every in-flight chunk and shut the pool down.  A worker
-        failure must not leak the other chunks' shared memory or mask the
-        first error — drain everything, then re-raise the first."""
+        failure must not leak ANY chunk's shared memory or mask the first
+        error — drain everything, then re-raise the first."""
         first_err = None
-        while self._inflight:
-            try:
-                self._drain_oldest()
-            except BaseException as err:  # noqa: BLE001 — re-raised below
-                if first_err is None:
-                    first_err = err
-        self._pool.shutdown()
+        try:
+            while self._inflight:
+                try:
+                    self._drain_oldest()
+                except BaseException as err:  # noqa: BLE001 — re-raised below
+                    if first_err is None:
+                        first_err = err
+        finally:
+            # belt and braces: _drain_oldest unlinks its own chunk on all
+            # paths, but an interrupt between drains must not leak the
+            # rest of the window either
+            self._release_inflight()
+            self._shutdown_pool(wait=first_err is None)
         if first_err is not None:
             raise first_err
 
@@ -329,6 +687,15 @@ class _WriterPool:
             self.finish()
         except BaseException:  # noqa: BLE001 — cleanup on failure path
             pass
+
+    def _release_inflight(self):
+        while self._inflight:
+            entry = self._inflight.pop(0)
+            try:
+                entry["shm"].close()
+                entry["shm"].unlink()
+            except Exception:  # pragma: no cover - cleanup best effort
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -378,28 +745,69 @@ def _manifest_fingerprint(n_obs, seed, dms, noise_norms, tmpl, parfile,
     }
 
 
+def _load_manifest(out_dir):
+    """The manifest dict, or None when absent/unreadable (a truncated
+    manifest from a crash mid-rewrite must not kill the resume — the
+    journal and file hashes are the durable record)."""
+    path = os.path.join(out_dir, _MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _atomic_write_json(path, obj, indent=None):
+    """THE crash-safe JSON write: temp + fsync + rename, Orbax-style —
+    a crash leaves either the old file or the new one, never a truncated
+    hybrid.  Manifest and supervisor cursor both write through here so
+    the durability contract lives in one place."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _write_manifest(out_dir, manifest):
+    _atomic_write_json(os.path.join(out_dir, _MANIFEST_NAME), manifest,
+                       indent=1)
+
+
 def _check_manifest(out_dir, fp, resume):
     """Write the manifest on first use; on resume, refuse a mismatch
     (ADVICE r2: resume previously keyed on file existence alone, silently
-    keeping stale files from a run with different seed/dms/config)."""
+    keeping stale files from a run with different seed/dms/config).
+
+    Comparison is fingerprint-keyed only, and non-fingerprint keys a
+    supervisor recorded ("files" hashes, "quarantined") survive the
+    rewrite on a matching resume; ``resume=False`` starts clean.
+
+    A manifest that EXISTS but cannot be parsed refuses a resume loudly:
+    with no readable fingerprint there is no way to prove the out_dir
+    holds this ensemble, and trusting existing files anyway is exactly
+    the silent-mixing bug the manifest exists to prevent."""
     path = os.path.join(out_dir, _MANIFEST_NAME)
-    if os.path.exists(path):
-        with open(path) as f:
-            old = json.load(f)
+    old = _load_manifest(out_dir)
+    if old is None and resume and os.path.exists(path):
+        raise RuntimeError(
+            f"manifest {path} exists but is unreadable; cannot prove the "
+            "out_dir holds this ensemble's files. Use resume=False to "
+            "overwrite, or a fresh out_dir.")
+    merged = dict(fp)
+    if old is not None:
         # manifests written before packing existed lack the key and mean
         # one observation per file; a legitimate resume must not abort
         old.setdefault("obs_per_file", 1)
-        if resume and old != fp:
-            diff = {k: (old.get(k), fp[k]) for k in fp if old.get(k) != fp[k]}
-            raise ExportManifestError(
-                f"out_dir {out_dir} holds an export with different "
-                f"parameters {diff}; resuming would silently mix two "
-                "ensembles. Use a fresh out_dir or resume=False to "
-                "overwrite.")
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(fp, f, indent=1)
-    os.replace(tmp, path)
+        if resume:
+            mismatches = {k: (old.get(k), fp[k])
+                          for k in fp if old.get(k) != fp[k]}
+            if mismatches:
+                raise ExportManifestError(out_dir, mismatches)
+            extras = {k: v for k, v in old.items() if k not in fp}
+            merged = {**extras, **fp}
+    _write_manifest(out_dir, merged)
 
 
 class _GroupPacker:
@@ -468,7 +876,7 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                             chunk_size=256, progress=None, resume=True,
                             parfile=None, MJD_start=56000.0,
                             ref_MJD=56000.0, writers=None,
-                            obs_per_file=1):
+                            obs_per_file=1, supervisor=None, faults=None):
     """Export ``n_obs`` ensemble observations as PSRFITS files.
 
     Args:
@@ -508,10 +916,29 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             ``host_write_s_per_obs``) is amortized ``obs_per_file``-fold.
             Incompatible with per-observation ``dms`` (a file carries one
             CHAN_DM/DM header).
+        supervisor: optional
+            :class:`psrsigsim_tpu.runtime.RunSupervisor` — arms the
+            fault-tolerant run loop: per-file sha256 journaling, hash-
+            verified resume, the in-graph finite-mask guard with NaN
+            quarantine + salted retry, and the append-only chunk journal.
+            Most callers should use
+            :func:`psrsigsim_tpu.runtime.supervised_export` instead of
+            passing one by hand.
+        faults: optional :class:`psrsigsim_tpu.runtime.FaultPlan` —
+            deterministic fault injection for tests; never armed unless a
+            plan is passed explicitly.
 
     Returns:
         list of the output file paths (length ``ceil(n_obs/obs_per_file)``).
     """
+    if resume == "verify" and supervisor is None:
+        # hash-verified resume is a supervisor capability; silently
+        # downgrading to exists-only resume would ship the very torn
+        # files the caller asked to re-check
+        raise ValueError(
+            'resume="verify" requires supervision: use '
+            "psrsigsim_tpu.runtime.supervised_export (or pass "
+            "supervisor=)")
     obs_per_file = int(obs_per_file)
     if obs_per_file < 1:
         raise ValueError("obs_per_file must be >= 1")
@@ -552,16 +979,25 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     # a finished file is the unit of resume; files are written to a temp
     # name and renamed on success, so existence implies completeness and
     # whole chunks of finished work skip the device entirely (a chunk
-    # skips only when every file any of its observations feeds exists)
+    # skips only when every file any of its observations feeds exists).
+    # Under a supervisor the definition of "done" sharpens: hash-verified
+    # resume re-checks each existing file's sha256 against the journal/
+    # manifest record instead of trusting existence.
     skip = None
     skip_group = None
+    if supervisor is not None:
+        def file_done(path):
+            return supervisor.file_ok(path)
+    else:
+        def file_done(path):
+            return os.path.exists(path)
     if resume:
         # skip_group is THE definition of "this group's file is done";
         # it feeds the packer so finished straddling groups are never
         # buffered (ADVICE r5 #2), and the chunk-level predicate derives
         # from it so a change to resume semantics touches one place
         def skip_group(g):
-            return os.path.exists(paths[g])
+            return file_done(paths[g])
 
         def skip(start, count):
             g_lo = start // obs_per_file
@@ -588,13 +1024,24 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
              "parfile": parfile, "MJD_start": MJD_start, "ref_MJD": ref_MJD,
              # workers must barycenter with the SAME ephemeris as the
              # parent (see _writer_init); None = analytic/PSS_EPHEM
-             "ephemeris_source": _ephem._EPHEM_SOURCE}
+             "ephemeris_source": _ephem._EPHEM_SOURCE,
+             # supervised runs journal per-file sha256; fault plans ride
+             # to workers inside the same pickled state
+             "hash_files": supervisor is not None,
+             "faults": faults}
     dms_np = None if dms is None else np.asarray(dms, np.float64)
+
+    # the supervisor journals a chunk the moment its files are durably
+    # written — from the pool's FIFO drain or straight after serial writes
+    commit = None
+    if supervisor is not None:
+        commit = supervisor.chunk_committed
 
     pool = None
     if writers > 1:
         try:
-            pool = _WriterPool(writers, pickle.dumps(state))
+            pool = _WriterPool(writers, pickle.dumps(state), state,
+                               on_chunk_done=commit)
         except Exception as err:  # pragma: no cover - environment-dependent
             import warnings
 
@@ -603,13 +1050,35 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                 "in-process writes", RuntimeWarning)
             pool = None
 
+    # NaN-injection (tests) poisons the MAIN pass inputs only; the
+    # manifest fingerprint and the retry pass always use the clean arrays
+    norms_main = noise_norms
+    if supervisor is not None:
+        norms_main = supervisor.poisoned_noise_norms(
+            n_obs, noise_norms, default=ens.noise_norm)
+
+    bad_obs = set()   # global ids quarantined by the finite-mask guard
+
+    def serial_commit(token, results):
+        if commit is not None:
+            commit(token, results)
+
     ok = False
     try:
-        for start, (data, scl, offs) in ens.iter_chunks(
+        for start, block in ens.iter_chunks(
             n_obs, chunk_size=chunk_size, seed=seed, dms=dms,
-            noise_norms=noise_norms, quantized=True, progress=progress,
+            noise_norms=norms_main, quantized=True, progress=progress,
             skip_chunk=skip, byte_order="big",
+            finite_mask=supervisor is not None,
         ):
+            if supervisor is not None:
+                data, scl, offs, finite = block
+                # the fused in-graph guard: one small bool host array per
+                # chunk, never a per-observation round-trip
+                bad_obs |= supervisor.observe_chunk(
+                    start, np.asarray(finite))
+            else:
+                data, scl, offs = block
             # the device already emitted big-endian bit patterns
             # (ops.swap16): reinterpret, so every downstream record-array
             # refill and PSRFITS.save cast is a same-dtype memcpy
@@ -618,26 +1087,37 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                 jobs = []
                 for j in range(data.shape[0]):
                     i = start + j
-                    if resume and os.path.exists(paths[i]):
+                    if i in bad_obs:
+                        continue  # quarantined: retried after the loop
+                    if resume and file_done(paths[i]):
                         continue
                     jobs.append((j, paths[i],
                                  None if dms_np is None else dms_np[i]))
                 if not jobs:
                     continue
+                token = ("chunk", start, [p for _, p, _ in jobs])
                 if pool is not None:
-                    pool.submit_chunk((data, scl, offs), jobs)
+                    pool.submit_chunk((data, scl, offs), jobs, token=token)
                 else:
-                    for j, path, dm in jobs:
-                        _write_obs(state, path,
-                                   (data[j], scl[j], offs[j]), dm)
+                    serial_commit(token,
+                                  _serial_write_jobs(state, (data, scl, offs),
+                                                     jobs))
                 continue
-            todo = list(packer.add_chunk(start, (data, scl, offs),
-                                         skip_group=skip_group))
+            todo = [(g, packed)
+                    for g, packed in packer.add_chunk(
+                        start, (data, scl, offs), skip_group=skip_group)
+                    # a group holding ANY quarantined observation is not
+                    # written this pass; the retry phase re-runs and
+                    # writes it whole
+                    if not any(i in bad_obs
+                               for i in range(*packer.group_span(g)))]
             if not todo:
                 continue
             if pool is None:
                 for g, packed in todo:
-                    _write_obs(state, paths[g], packed, None)
+                    sha = _write_obs(state, paths[g], packed, None)
+                    serial_commit(("group", g, [paths[g]]),
+                                  [(paths[g], sha)])
                 continue
             # one SHM block + one job batch per (shape, chunk): all the
             # groups a device chunk completes fan out across the pool
@@ -651,11 +1131,88 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                     for i in range(3))
                 jobs = [(k, paths[g], None)
                         for k, (g, _) in enumerate(items)]
-                pool.submit_chunk(stacked, jobs)
+                pool.submit_chunk(
+                    stacked, jobs,
+                    token=("groups", [g for g, _ in items],
+                           [paths[g] for g, _ in items]))
         ok = True
     finally:
         if pool is not None:
             # on the failure path, clean up without masking the original
             # exception; on success, surface any worker error
             pool.finish() if ok else pool.abort()
+            if pool.degraded and supervisor is not None:
+                supervisor.note_degraded()
+
+    if supervisor is not None and bad_obs:
+        _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
+                           n_obs, seed, dms, noise_norms, obs_per_file,
+                           dms_np)
     return paths
+
+
+def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
+                       n_obs, seed, dms, noise_norms, obs_per_file, dms_np):
+    """Re-run every quarantined observation ONCE with a fresh fold of its
+    PRNG key (clean inputs — injection poisons the main pass only), write
+    the files whose observations all came back finite, and record the
+    rest as permanently quarantined.
+
+    Packed groups re-run their healthy members with the ORIGINAL keys, so
+    a recovered group's healthy rows stay bit-identical to an untroubled
+    export; only the re-drawn observations differ (and are journaled)."""
+    salt = supervisor.retry_fold_salt
+    groups = sorted({i // obs_per_file for i in bad_obs})
+    if not supervisor.retry_enabled:
+        for g in groups:
+            first, end = packer.group_span(g)
+            bad = [i for i in range(first, end) if i in bad_obs]
+            supervisor.record_retry(g, [], bad)
+        return
+    # at most TWO device dispatches regardless of how many groups are
+    # affected (each distinct batch width is a fresh XLA compile): one
+    # salted run over every bad observation, one original-key run over
+    # every healthy member of an affected group, regrouped on host
+    all_bad = sorted(i for i in bad_obs)
+    all_good = sorted(
+        i for g in groups for i in range(*packer.group_span(g))
+        if i not in bad_obs)
+    parts = {}
+    if all_good:
+        dg, sg, og, _ = ens.run_quantized_at(
+            all_good, seed=seed, dms=dms, noise_norms=noise_norms,
+            byte_order="big")
+        dg, sg, og = (np.asarray(a) for a in (dg, sg, og))
+        for k, i in enumerate(all_good):
+            parts[i] = (dg[k], sg[k], og[k])
+    db, sb, ob, mb = ens.run_quantized_at(
+        all_bad, seed=seed, dms=dms, noise_norms=noise_norms,
+        byte_order="big", fold_salt=salt)
+    db, sb, ob, mb = (np.asarray(a) for a in (db, sb, ob, mb))
+    healed = {}
+    for k, i in enumerate(all_bad):
+        if mb[k].all():
+            healed[i] = (db[k], sb[k], ob[k])
+    for g in groups:
+        first, end = packer.group_span(g)
+        members = list(range(first, end))
+        bad = [i for i in members if i in bad_obs]
+        still_bad = [i for i in bad if i not in healed]
+        supervisor.record_retry(g, bad, still_bad)
+        if still_bad:
+            # the group's file is NOT written; the manifest records the
+            # loss and a later resume gets a fresh attempt (the file
+            # reads as missing)
+            continue
+        group_parts = {**{i: parts[i] for i in members if i not in bad_obs},
+                       **{i: healed[i] for i in bad}}
+        packed = tuple(
+            np.concatenate([group_parts[i][c] for i in members], axis=0)
+            for c in range(3))
+        packed = (packed[0].view(">i2"), packed[1], packed[2])
+        dm = None
+        if dms_np is not None and obs_per_file == 1:
+            dm = dms_np[members[0]]
+        sha = _write_obs(state, paths[g], packed, dm)
+        supervisor.chunk_committed(("retry", g, [paths[g]]),
+                                   [(paths[g], sha)])

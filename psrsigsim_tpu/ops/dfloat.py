@@ -31,6 +31,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import ensure_optimization_barrier_batch_rule
+
+# some deployed JAX versions ship the barrier primitive without a vmap
+# rule, which kills every vmapped pipeline at trace time (utils/compat.py)
+ensure_optimization_barrier_batch_rule()
+
 __all__ = ["split_f64", "two_sum", "two_prod", "df_mul_f32", "df_recip",
            "df_mod1", "df_div_f32"]
 

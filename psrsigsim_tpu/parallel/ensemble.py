@@ -126,11 +126,17 @@ class FoldEnsemble:
             # quantization — the export leaves the device as quarter-size
             # bytes plus real DAT_SCL/DAT_OFFS columns.  Per-row reductions
             # only, so the channel shard needs no collectives and the bytes
-            # are identical for any mesh shape.
+            # are identical for any mesh shape.  The fourth output is the
+            # fused finite-mask guard (checkify-style, no host round-trip
+            # per observation): per (obs, channel) True iff every sample is
+            # finite, reduced in-graph BEFORE quantization — a NaN/Inf
+            # would otherwise be silently swallowed into the int16 codes.
             blocks = _local(keys, dms, norms, profiles, freqs, chan_ids)
-            return jax.vmap(
+            finite = jnp.all(jnp.isfinite(blocks), axis=-1)  # (B_loc, C_loc)
+            data, scl, offs = jax.vmap(
                 lambda b: subint_quantize(b, cfg.nsub, cfg.nph)
             )(blocks)
+            return data, scl, offs, finite
 
         _quant_specs = dict(
             mesh=mesh,
@@ -146,6 +152,7 @@ class FoldEnsemble:
                 P(OBS_AXIS, None, CHAN_AXIS, None),
                 P(OBS_AXIS, None, CHAN_AXIS),
                 P(OBS_AXIS, None, CHAN_AXIS),
+                P(OBS_AXIS, CHAN_AXIS),
             ),
         )
         self._run_sharded_quantized = jax.jit(
@@ -157,9 +164,9 @@ class FoldEnsemble:
             # the host PSRFITS writer refills its '>i2' record arrays with
             # a same-dtype memcpy instead of a byteswapping cast (the
             # measured bound of the packed bulk-export write machinery)
-            d, s, o = _local_quantized(keys, dms, norms, profiles, freqs,
-                                       chan_ids)
-            return swap16(d), s, o
+            d, s, o, m = _local_quantized(keys, dms, norms, profiles, freqs,
+                                          chan_ids)
+            return swap16(d), s, o, m
 
         self._run_sharded_quantized_be = jax.jit(
             shard_map(_local_quantized_be, **_quant_specs)
@@ -197,7 +204,8 @@ class FoldEnsemble:
         )
         return out[:n_obs] if pad else out
 
-    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None):
+    def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None,
+                      return_finite=False):
         """Simulate ``n_obs`` observations and quantize ON DEVICE to PSRFITS
         int16 subints (:func:`~psrsigsim_tpu.ops.subint_quantize`).
 
@@ -219,21 +227,44 @@ class FoldEnsemble:
         when a different program shape or channel split changes the local
         batch width the backend vectorizes over, which can flip rare codes
         by ±1 (see tests/test_quantize.py).
+
+        ``return_finite=True`` appends the in-graph finite-mask guard: a
+        ``(n_obs, Nchan)`` bool array, True where every sample of that
+        (observation, channel) was finite BEFORE quantization.  The mask
+        is fused into the same program (checkify-style accumulation — no
+        per-observation host round-trip); the run supervisor keys its NaN
+        quarantine off it.
         """
         keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
-        data, scl, offs = self._run_sharded_quantized(
+        data, scl, offs, finite = self._run_sharded_quantized(
             keys, dms, norms, self._profiles, self._freqs, self._chan_ids
         )
         if pad:
-            data, scl, offs = data[:n_obs], scl[:n_obs], offs[:n_obs]
+            data, scl, offs, finite = (data[:n_obs], scl[:n_obs],
+                                       offs[:n_obs], finite[:n_obs])
+        if return_finite:
+            return data, scl, offs, finite
         return data, scl, offs
 
-    def _prep_chunk(self, idx, seed, dms_full, norms_full):
+    def _prep_chunk(self, idx, seed, dms_full, norms_full, fold_salt=None):
         """Inputs for the global observation indices ``idx`` (already padded
-        to a fixed chunk length), placed with the obs sharding."""
+        to a fixed chunk length), placed with the obs sharding.
+
+        ``fold_salt``: optional int folded into every observation's key
+        AFTER the normal (seed, global index) derivation — the "fresh fold"
+        the run supervisor uses to re-draw a NaN-quarantined observation
+        without perturbing any other observation's stream (salt=None is
+        the production path and matches :meth:`run` exactly)."""
         root = jax.random.key(seed)
         idx = jnp.asarray(idx)
-        keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx)
+        if fold_salt is None:
+            keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx)
+        else:
+            salt = int(fold_salt)
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    stage_key(root, "user", i), salt)
+            )(idx)
         dms = (
             jnp.full(idx.shape, self.dm, jnp.float32)
             if dms_full is None
@@ -249,9 +280,44 @@ class FoldEnsemble:
                 jax.device_put(dms, obs_sharding),
                 jax.device_put(norms, obs_sharding))
 
+    def run_quantized_at(self, indices, seed=0, dms=None, noise_norms=None,
+                         byte_order="little", fold_salt=None):
+        """Quantize exactly the observations ``indices`` (global ids) in
+        one dispatch — the run supervisor's quarantine/retry primitive.
+
+        ``dms`` / ``noise_norms`` are the FULL per-observation arrays of
+        the parent run (or None), indexed by the global ids, so a re-run
+        observation sees exactly the inputs the main pass gave it.
+        ``fold_salt`` (see :meth:`_prep_chunk`): None reproduces the main
+        pass bit-for-bit; an int folds a fresh stream for every listed
+        observation.  ``byte_order`` as :meth:`iter_chunks`.
+
+        Returns ``(data, scl, offs, finite)`` trimmed to ``len(indices)``,
+        in the order given.
+        """
+        if byte_order not in ("little", "big"):
+            raise ValueError("byte_order must be 'little' or 'big'")
+        indices = np.asarray(indices, np.int64).reshape(-1)
+        if indices.size == 0:
+            raise ValueError("indices must be non-empty")
+        n = indices.size
+        n_obs_shards = self.mesh.shape[OBS_AXIS]
+        pad = (-n) % n_obs_shards
+        idx = indices[np.arange(n + pad) % n]  # tile modulo, as _prep_inputs
+        keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms, noise_norms,
+                                                fold_salt=fold_salt)
+        prog = (self._run_sharded_quantized_be if byte_order == "big"
+                else self._run_sharded_quantized)
+        data, scl, offs, finite = prog(
+            keys, dms_c, norms_c, self._profiles, self._freqs,
+            self._chan_ids,
+        )
+        return data[:n], scl[:n], offs[:n], finite[:n]
+
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
                     noise_norms=None, quantized=False, progress=None,
-                    skip_chunk=None, prefetch=1, byte_order="little"):
+                    skip_chunk=None, prefetch=1, byte_order="little",
+                    finite_mask=False):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -291,9 +357,17 @@ class FoldEnsemble:
         array, i.e. ``data.view('>i2')`` yields the true values.  Used by
         the PSRFITS bulk exporter so host record-array refills are
         same-dtype memcpys.
+
+        ``finite_mask`` (quantized only): yield ``(data, scl, offs, mask)``
+        with ``mask`` the in-graph ``(count, Nchan)`` finite guard (see
+        :meth:`run_quantized`).  The supervised exporter quarantines
+        non-finite observations off this mask instead of re-scanning the
+        payload on host.
         """
         if byte_order not in ("little", "big"):
             raise ValueError("byte_order must be 'little' or 'big'")
+        if finite_mask and not quantized:
+            raise ValueError("finite_mask requires quantized=True")
         self._validate_per_obs(n_obs, dms, noise_norms)
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
@@ -315,10 +389,12 @@ class FoldEnsemble:
                 prog = (self._run_sharded_quantized_be
                         if byte_order == "big"
                         else self._run_sharded_quantized)
-                d, s, o = prog(
+                d, s, o, m = prog(
                     keys, dms_c, norms_c, self._profiles, self._freqs,
                     self._chan_ids,
                 )
+                if finite_mask:
+                    return (d[:count], s[:count], o[:count], m[:count])
                 return (d[:count], s[:count], o[:count])
             out = self._run_sharded(
                 keys, dms_c, norms_c, self._profiles, self._freqs,

@@ -1,0 +1,27 @@
+"""Fault-tolerant run supervision: the robustness backbone of bulk runs.
+
+- :mod:`~psrsigsim_tpu.runtime.supervisor` — the resumable, self-healing
+  run loop around the chunked ensemble -> PSRFITS export path
+  (:func:`supervised_export` / :class:`RunSupervisor`): crash-safe
+  journaled output with sha256-verified resume, in-graph NaN quarantine
+  with salted retry, and an append-only chunk journal + atomic cursor.
+- :mod:`~psrsigsim_tpu.runtime.retry` — capped exponential backoff
+  shared by every self-healing loop (writer-pool respawn, retries).
+- :mod:`~psrsigsim_tpu.runtime.faults` — deterministic, explicitly-armed
+  fault injection (named points, cross-process once-semantics) so all of
+  the above is exercised by tests instead of by outages.
+"""
+
+from .faults import FaultPlan
+from .retry import RetriesExhausted, RetryPolicy, call_with_retry
+from .supervisor import RunResult, RunSupervisor, supervised_export
+
+__all__ = [
+    "FaultPlan",
+    "RetryPolicy",
+    "RetriesExhausted",
+    "call_with_retry",
+    "RunResult",
+    "RunSupervisor",
+    "supervised_export",
+]

@@ -1,0 +1,98 @@
+"""Capped exponential backoff for self-healing host-side loops.
+
+The run supervisor and the export writer pool share one retry idiom:
+attempt, back off exponentially up to a cap, give up after a bounded
+number of attempts and let the caller degrade (pool -> serial writer,
+retry -> quarantine record).  Centralizing it here keeps the policy
+testable in isolation and the call sites honest about their bounds —
+an unbounded `while True: respawn()` is exactly the failure amplifier
+a multi-hour 10k-observation export cannot afford.
+
+Host-only module: nothing here may touch JAX (psrlint keeps it out of
+the device-module scope).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["RetryPolicy", "call_with_retry", "RetriesExhausted"]
+
+
+class RetriesExhausted(RuntimeError):
+    """All attempts of :func:`call_with_retry` failed.
+
+    The last underlying exception is chained as ``__cause__`` and kept
+    on :attr:`last_error`; :attr:`attempts` records how many were made.
+    """
+
+    def __init__(self, attempts, last_error):
+        self.attempts = int(attempts)
+        self.last_error = last_error
+        super().__init__(
+            f"gave up after {attempts} attempt(s); last error: "
+            f"{last_error!r}")
+
+
+class RetryPolicy:
+    """Capped exponential backoff schedule.
+
+    ``delay(k)`` is the sleep before retry ``k`` (0-based):
+    ``min(max_delay, base_delay * multiplier**k)``.  ``max_attempts``
+    bounds the total number of attempts (first try included); the
+    policy object is immutable and shareable across call sites.
+    """
+
+    def __init__(self, max_attempts=3, base_delay=0.5, max_delay=30.0,
+                 multiplier=2.0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if base_delay < 0 or max_delay < 0:
+            raise ValueError("delays must be >= 0")
+        if multiplier < 1.0:
+            raise ValueError("multiplier must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+
+    def delay(self, retry_index):
+        """Backoff before the ``retry_index``-th retry (0-based)."""
+        return min(self.max_delay,
+                   self.base_delay * self.multiplier ** retry_index)
+
+    def delays(self):
+        """The full schedule: one delay per retry (``max_attempts - 1``)."""
+        return [self.delay(k) for k in range(self.max_attempts - 1)]
+
+    def __repr__(self):
+        return (f"RetryPolicy(max_attempts={self.max_attempts}, "
+                f"base_delay={self.base_delay}, max_delay={self.max_delay}, "
+                f"multiplier={self.multiplier})")
+
+
+def call_with_retry(fn, policy=None, retry_on=(Exception,), on_retry=None,
+                    sleep=time.sleep):
+    """Call ``fn()`` under ``policy``, retrying on ``retry_on``.
+
+    ``on_retry(attempt_index, error, delay)`` is invoked before each
+    backoff sleep — call sites log/count there.  Raises
+    :class:`RetriesExhausted` (with the last error chained) once the
+    attempt budget is spent.  ``sleep`` is injectable so tests run the
+    schedule without wall-clock cost.
+    """
+    policy = policy or RetryPolicy()
+    last = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except retry_on as err:  # noqa: PERF203 — retry loop by design
+            last = err
+            if attempt == policy.max_attempts - 1:
+                break
+            d = policy.delay(attempt)
+            if on_retry is not None:
+                on_retry(attempt, err, d)
+            if d > 0:
+                sleep(d)
+    raise RetriesExhausted(policy.max_attempts, last) from last

@@ -309,6 +309,40 @@ class Simulation:
         ens.ephemeris_source = self._ephemeris
         return ens
 
+    def export_ensemble(self, n_obs, out_dir, template=None, mesh=None,
+                        supervised=True, **export_kw):
+        """Export ``n_obs`` Monte-Carlo observations of this simulation as
+        PSRFITS files — the bulk counterpart of :meth:`save_simulation`.
+
+        Builds the sharded ensemble (:meth:`to_ensemble`) and streams it
+        through the PSRFITS bulk exporter.  ``supervised=True`` (default)
+        routes through :func:`psrsigsim_tpu.runtime.supervised_export`:
+        crash-safe journaled output, sha256-verified resume, and the
+        in-graph NaN quarantine — the configuration every long-running
+        production export should use — and returns its
+        :class:`~psrsigsim_tpu.runtime.RunResult`.  ``supervised=False``
+        calls the bare exporter and returns the path list.
+
+        ``template`` defaults to this simulation's ``tempfile``;
+        ``export_kw`` is forwarded (seed, dms, noise_norms, chunk_size,
+        writers, obs_per_file, resume — including ``resume="verify"``
+        under supervision).
+        """
+        if template is None:
+            template = self.tempfile
+        if template is None:
+            raise RuntimeError("No template PSRFITS file provided.")
+        ens = self.to_ensemble(mesh=mesh)
+        if supervised:
+            from ..runtime import supervised_export
+
+            return supervised_export(ens, n_obs, out_dir, template,
+                                     self.pulsar, **export_kw)
+        from ..io import export_ensemble_psrfits
+
+        return export_ensemble_psrfits(ens, n_obs, out_dir, template,
+                                       self.pulsar, **export_kw)
+
     def save_simulation(self, outfile="simfits", out_format="psrfits",
                         parfile=None, ref_MJD=56000.0, MJD_start=55999.9861):
         """Save simulated data as PSRFITS (template required) or PSRCHIVE

@@ -1,0 +1,65 @@
+"""Version-compat shims for the pinned JAX runtime.
+
+The simulation graph pins intermediates with ``lax.optimization_barrier``
+(ops/dfloat.py: error-free float transformations that XLA's algebraic
+simplifier would otherwise rewrite away).  Some deployed JAX versions
+(observed: 0.4.37) ship the primitive without a vmap batching rule, so
+every vmapped pipeline — i.e. the whole ensemble/export path — dies with
+``NotImplementedError: Batching rule for 'optimization_barrier' not
+implemented``, and the same versions' ``shard_map`` replication checker
+applies the single-output ``_standard_check`` to this multi-output
+primitive, crashing with ``TypeError: 'NoneType' object is not
+iterable`` when every operand traces as a constant.  Both rules are
+trivially the per-operand identity (the barrier is elementwise-identity
+on each operand), so we register them ourselves when missing/broken
+instead of failing a multi-hour run at trace time.
+
+Registration is idempotent and a no-op on JAX versions that already
+provide working rules; failures to locate the private primitive degrade
+to doing nothing (the newer JAX that moved it has the rules built in).
+"""
+
+from __future__ import annotations
+
+__all__ = ["ensure_optimization_barrier_batch_rule"]
+
+
+def ensure_optimization_barrier_batch_rule():
+    """Register vmap/shard_map rules for ``optimization_barrier`` if the
+    running JAX lacks working ones.  Idempotent — both registries are
+    checked before writing, so repeated calls are free."""
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # pragma: no cover - newer JAX moved/renamed it,
+        return           # and newer JAX has the rule anyway
+
+    if optimization_barrier_p not in batching.primitive_batchers:
+        def _batch_rule(args, dims):
+            # the barrier is identity per operand: bind on the batched
+            # args and pass every operand's batch dim straight through
+            outs = optimization_barrier_p.bind(*args)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            return outs, dims
+
+        batching.primitive_batchers[optimization_barrier_p] = _batch_rule
+
+    try:
+        from jax.experimental import shard_map as _sm
+        check_rules = _sm._check_rules
+    except (ImportError, AttributeError):  # pragma: no cover - newer JAX
+        return
+    import functools
+
+    rule = check_rules.get(optimization_barrier_p)
+    if isinstance(rule, functools.partial) and \
+            rule.func is getattr(_sm, "_standard_check", None):
+        def _rep_rule(mesh, *in_rep, **params):
+            # per-operand identity: each output carries its operand's
+            # replication set (may be None for constants — the broken
+            # standard rule collapsed those to a bare None, which the
+            # multi-result writeback cannot iterate)
+            return list(in_rep)
+
+        check_rules[optimization_barrier_p] = _rep_rule

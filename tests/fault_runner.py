@@ -1,0 +1,92 @@
+"""Subprocess driver for kill/resume fault tests (tests/test_faults.py).
+
+SIGKILL-based fault points (``run.kill``, ``file.partial``) kill the
+whole exporting process, so the pytest process cannot host the faulted
+run itself — this script is launched as a subprocess, dies mid-export
+when the armed fault fires, and is launched again (same out_dir, no
+plan or a verify-resume) to prove the journaled export resumes to
+bit-identical output.
+
+Usage::
+
+    python tests/fault_runner.py OUT_DIR [--plan PLAN_JSON]
+        [--resume-mode resume|verify] [--n-obs N] [--chunk-size N]
+        [--writers N] [--obs-per-file N]
+
+``PLAN_JSON`` holds ``{"scratch_dir": ..., "spec": {...}}`` for the
+:class:`~psrsigsim_tpu.runtime.faults.FaultPlan`.  The simulation config
+is fixed (the same small fold ensemble the export tests use) so every
+invocation with the same seed generates identical data.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# mirror tests/conftest.py BEFORE jax initializes: unit-test platform is
+# an 8-device virtual CPU so chunk padding matches the pytest process
+os.environ["JAX_PLATFORMS"] = os.environ.get("PSS_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SIM_CONFIG = {
+    "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+    "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+    "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+    "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+    "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+    "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+    "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+}
+TEMPLATE = os.path.join(REPO, "data",
+                        "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+SEED = 3
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--plan", default=None)
+    ap.add_argument("--resume-mode", default="resume",
+                    choices=["resume", "verify"])
+    ap.add_argument("--n-obs", type=int, default=5)
+    ap.add_argument("--chunk-size", type=int, default=2)
+    ap.add_argument("--writers", type=int, default=1)
+    ap.add_argument("--obs-per-file", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", False)
+
+    from psrsigsim_tpu.runtime import FaultPlan, supervised_export
+    from psrsigsim_tpu.simulate import Simulation
+
+    plan = None
+    if args.plan:
+        with open(args.plan) as f:
+            spec = json.load(f)
+        plan = FaultPlan(spec["scratch_dir"], spec["spec"])
+
+    sim = Simulation(psrdict=SIM_CONFIG)
+    sim.init_all()
+    ens = sim.to_ensemble()
+    res = supervised_export(
+        ens, args.n_obs, args.out_dir, TEMPLATE, ens.pulsar, seed=SEED,
+        chunk_size=args.chunk_size, writers=args.writers,
+        obs_per_file=args.obs_per_file, faults=plan,
+        resume="verify" if args.resume_mode == "verify" else True)
+    print(json.dumps({
+        "paths": res.paths, "quarantined": res.quarantined,
+        "retried": res.retried, "degraded": res.degraded}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,247 @@
+"""Fault-injection suite (``-m faults`` / ``make test-faults``): kill the
+export at its named injection points and prove the run loop heals —
+SIGKILL + resume is bit-identical, writer-pool deaths respawn, and a
+forced triple death completes through the serial-writer fallback.
+
+SIGKILL-based points (``run.kill``, ``file.partial``) kill the whole
+exporting process, so those scenarios drive tests/fault_runner.py as a
+subprocess; pool-level faults run in-process."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.runtime import FaultPlan, supervised_export
+from psrsigsim_tpu.simulate import Simulation
+
+pytestmark = pytest.mark.faults
+
+RUNNER = os.path.join(os.path.dirname(__file__), "fault_runner.py")
+TEMPLATE = os.path.join(
+    os.path.dirname(__file__), "..", "data", "B1855+09.L-wide.PUPPI.11y.x.sum.sm"
+)
+
+# 12 observations over the 8-wide virtual obs mesh = two device chunks at
+# chunk_size 8: faults can land between commits, which is the whole point
+N_OBS, CHUNK = 12, 8
+
+
+def _run_export(out_dir, plan_file=None, resume_mode="resume",
+                expect_kill=False):
+    cmd = [sys.executable, RUNNER, out_dir, "--n-obs", str(N_OBS),
+           "--chunk-size", str(CHUNK), "--resume-mode", resume_mode]
+    if plan_file:
+        cmd += ["--plan", plan_file]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=540)
+    if expect_kill:
+        assert proc.returncode in (-9, 137), (
+            f"expected SIGKILL, got rc={proc.returncode}\n{proc.stderr}")
+    else:
+        assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+def _write_plan(tmp_path, name, spec):
+    plan_file = str(tmp_path / f"{name}.json")
+    with open(plan_file, "w") as f:
+        json.dump({"scratch_dir": str(tmp_path / f"{name}_scratch"),
+                   "spec": spec}, f)
+    return plan_file
+
+
+def _fits(out_dir):
+    return sorted(glob.glob(os.path.join(out_dir, "*.fits")))
+
+
+@pytest.fixture(scope="module")
+def clean_dir(tmp_path_factory):
+    """One uninterrupted reference export every kill scenario compares
+    against, byte for byte."""
+    out = str(tmp_path_factory.mktemp("faults") / "clean")
+    _run_export(out)
+    paths = _fits(out)
+    assert len(paths) == N_OBS
+    return out
+
+
+class TestKillResume:
+    def test_sigkill_between_chunks_resumes_bit_identical(self, clean_dir,
+                                                          tmp_path):
+        """run.kill fires right after chunk 0's journal commit: the
+        process dies with 8 of 12 files on disk; the resume run finishes
+        the rest and every byte matches the uninterrupted export."""
+        out = str(tmp_path / "killed")
+        plan_file = _write_plan(tmp_path, "kill",
+                                {"run.kill": {"after_start": 0}})
+        _run_export(out, plan_file=plan_file, expect_kill=True)
+        survivors = _fits(out)
+        assert 0 < len(survivors) < N_OBS     # genuinely mid-run
+        _run_export(out, plan_file=plan_file)  # plan exhausted: no re-kill
+        got = _fits(out)
+        ref = _fits(clean_dir)
+        assert [os.path.basename(p) for p in got] == \
+               [os.path.basename(p) for p in ref]
+        for a, b in zip(ref, got):
+            assert open(a, "rb").read() == open(b, "rb").read(), b
+
+    def test_partial_file_kill_then_verify_resume(self, clean_dir,
+                                                  tmp_path):
+        """file.partial tears obs_00009 mid-write and SIGKILLs: the .tmp
+        must never be taken for a finished file, and resume="verify"
+        re-checks every survivor's sha256 before trusting it."""
+        out = str(tmp_path / "torn")
+        plan_file = _write_plan(
+            tmp_path, "torn", {"file.partial": {"match": "obs_00009"}})
+        _run_export(out, plan_file=plan_file, expect_kill=True)
+        assert os.path.exists(os.path.join(out, "obs_00009.fits.tmp"))
+        assert not os.path.exists(os.path.join(out, "obs_00009.fits"))
+        _run_export(out, resume_mode="verify")
+        ref = _fits(clean_dir)
+        got = _fits(out)
+        assert len(got) == N_OBS
+        for a, b in zip(ref, got):
+            assert open(a, "rb").read() == open(b, "rb").read(), b
+        # the stray .tmp was consumed by the rewrite
+        assert not glob.glob(os.path.join(out, "*.tmp"))
+
+
+@pytest.fixture(scope="module")
+def ens():
+    d = {
+        "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+        "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+        "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+        "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+        "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+        "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+        "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+    }
+    s = Simulation(psrdict=d)
+    s.init_all()
+    return s.to_ensemble()
+
+
+@pytest.fixture(scope="module")
+def serial_ref(ens, tmp_path_factory):
+    """Serial (no pool) reference export the pool scenarios diff against."""
+    out = str(tmp_path_factory.mktemp("pool") / "serial")
+    res = supervised_export(ens, 5, out, TEMPLATE, ens.pulsar, seed=3,
+                            chunk_size=3, writers=1)
+    return res.paths
+
+
+def _same_bytes(a_paths, b_paths):
+    return all(open(a, "rb").read() == open(b, "rb").read()
+               for a, b in zip(a_paths, b_paths))
+
+
+class TestWriterPoolSelfHealing:
+    def test_worker_crash_respawns_and_completes(self, ens, serial_ref,
+                                                 tmp_path):
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"writer.crash": {"match": "obs_00000",
+                                           "times": 1}})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            res = supervised_export(ens, 5, str(tmp_path / "out"), TEMPLATE,
+                                    ens.pulsar, seed=3, chunk_size=3,
+                                    writers=2, faults=plan)
+        assert not res.degraded
+        assert plan.shots_fired("writer.crash") == 1
+        assert any("writer pool died" in str(x.message) for x in w)
+        assert _same_bytes(serial_ref, res.paths)
+
+    def test_triple_pool_death_degrades_to_serial_writer(self, ens,
+                                                         serial_ref,
+                                                         tmp_path):
+        """Acceptance criterion: a forced triple writer-pool death
+        completes the export via the serial-writer fallback — degraded,
+        warned about, and still byte-identical."""
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"writer.crash": {"match": "obs_00000",
+                                           "times": 3}})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            res = supervised_export(ens, 5, str(tmp_path / "out"), TEMPLATE,
+                                    ens.pulsar, seed=3, chunk_size=3,
+                                    writers=2, faults=plan)
+        assert res.degraded
+        assert plan.shots_fired("writer.crash") == 3
+        assert any("degrading to the in-process serial writer"
+                   in str(x.message) for x in w)
+        assert _same_bytes(serial_ref, res.paths)
+        # the degradation is part of the run's durable record
+        events = [json.loads(line)["e"]
+                  for line in open(os.path.join(str(tmp_path / "out"),
+                                                "run_journal.jsonl"))]
+        assert "degraded" in events
+        # no shared-memory segments leaked on any of the exit paths
+        leaked = [n for n in os.listdir("/dev/shm")
+                  if n.startswith("psm_")] if os.path.isdir("/dev/shm") \
+            else []
+        assert not leaked, f"leaked shm segments: {leaked}"
+
+    def test_transient_shm_attach_failure_retries_job(self, ens, serial_ref,
+                                                      tmp_path):
+        plan = FaultPlan(str(tmp_path / "p"), {"shm.attach": {"times": 1}})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            res = supervised_export(ens, 5, str(tmp_path / "out"), TEMPLATE,
+                                    ens.pulsar, seed=3, chunk_size=3,
+                                    writers=2, faults=plan)
+        assert not res.degraded
+        assert any("writer job batch failed" in str(x.message) for x in w)
+        assert _same_bytes(serial_ref, res.paths)
+
+
+class TestNaNQuarantine:
+    def test_poisoned_obs_quarantined_retried_recovered(self, ens,
+                                                        tmp_path):
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"nan.obs": {"indices": [1]}})
+        out = str(tmp_path / "out")
+        res = supervised_export(ens, 4, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=4, writers=1, faults=plan)
+        assert res.retried == [1] and res.recovered == [1]
+        assert res.quarantined == []
+        assert all(map(os.path.exists, res.paths))
+        events = [json.loads(line)
+                  for line in open(os.path.join(out, "run_journal.jsonl"))]
+        quar = [e for e in events if e["e"] == "quarantine"]
+        assert [e["obs"] for e in quar] == [1]
+        assert quar[0]["bad_chans"] == ens.cfg.meta.nchan
+        # untouched observations byte-match a clean export
+        clean = str(tmp_path / "clean")
+        rc = supervised_export(ens, 4, clean, TEMPLATE, ens.pulsar, seed=0,
+                               chunk_size=4, writers=1)
+        same = [open(a, "rb").read() == open(b, "rb").read()
+                for a, b in zip(res.paths, rc.paths)]
+        assert same == [True, False, True, True]
+
+    def test_retry_disabled_records_quarantine_in_manifest(self, ens,
+                                                           tmp_path):
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"nan.obs": {"indices": [2]}})
+        out = str(tmp_path / "out")
+        res = supervised_export(ens, 4, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=4, writers=1, faults=plan,
+                                retry=False)
+        assert res.quarantined == [2]
+        assert not os.path.exists(res.paths[2])   # withheld, not corrupt
+        man = json.load(open(os.path.join(out, "export_manifest.json")))
+        assert man["quarantined"] == [2]
+
+    def test_unarmed_plan_never_fires_in_production_path(self, ens,
+                                                         tmp_path):
+        # faults=None end to end: identical to a clean supervised run
+        out = str(tmp_path / "out")
+        res = supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=2, writers=1)
+        assert res.retried == [] and res.quarantined == []
+        assert not res.degraded

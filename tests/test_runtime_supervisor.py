@@ -1,0 +1,376 @@
+"""Run supervisor building blocks: retry policy, fault plans, manifest
+diffs, hash-verified resume, the in-graph finite-mask guard, NaN
+quarantine bookkeeping, and chunk-size invariance
+(psrsigsim_tpu/runtime/, io/export.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.runtime import (
+    FaultPlan,
+    RetriesExhausted,
+    RetryPolicy,
+    call_with_retry,
+    supervised_export,
+)
+from psrsigsim_tpu.runtime.supervisor import RunSupervisor
+from psrsigsim_tpu.simulate import Simulation
+
+TEMPLATE = os.path.join(
+    os.path.dirname(__file__), "..", "data", "B1855+09.L-wide.PUPPI.11y.x.sum.sm"
+)
+
+
+@pytest.fixture(scope="module")
+def ens():
+    d = {
+        "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+        "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+        "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+        "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+        "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+        "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+        "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+        "seed": 8,
+    }
+    s = Simulation(psrdict=d)
+    s.init_all()
+    return s.to_ensemble()
+
+
+class TestRetryPolicy:
+    def test_delays_are_capped_exponential(self):
+        p = RetryPolicy(max_attempts=5, base_delay=1.0, max_delay=5.0,
+                        multiplier=2.0)
+        assert p.delays() == [1.0, 2.0, 4.0, 5.0]
+
+    def test_invalid_parameters_rejected(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_delay=-1)
+        with pytest.raises(ValueError):
+            RetryPolicy(multiplier=0.5)
+
+    def test_call_with_retry_succeeds_after_failures(self):
+        calls = []
+        sleeps = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = call_with_retry(flaky, RetryPolicy(max_attempts=4,
+                                                 base_delay=0.5),
+                              sleep=sleeps.append)
+        assert out == "ok" and len(calls) == 3
+        assert sleeps == [0.5, 1.0]   # backoff actually scheduled
+
+    def test_exhaustion_raises_with_cause_and_count(self):
+        def dead():
+            raise ValueError("always")
+
+        with pytest.raises(RetriesExhausted) as ei:
+            call_with_retry(dead, RetryPolicy(max_attempts=3, base_delay=0),
+                            sleep=lambda _: None)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.__cause__, ValueError)
+
+    def test_on_retry_observer_sees_each_backoff(self):
+        seen = []
+
+        def dead():
+            raise OSError("x")
+
+        with pytest.raises(RetriesExhausted):
+            call_with_retry(
+                dead, RetryPolicy(max_attempts=3, base_delay=2.0),
+                on_retry=lambda k, e, d: seen.append((k, d)),
+                sleep=lambda _: None)
+        assert seen == [(0, 2.0), (1, 4.0)]
+
+
+class TestFaultPlan:
+    def test_unknown_point_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultPlan(str(tmp_path), {"writer.crsh": {}})
+
+    def test_times_budget_and_match(self, tmp_path):
+        plan = FaultPlan(str(tmp_path), {"shm.attach": {"times": 2,
+                                                        "match": "psm_"}})
+        assert not plan.fire("shm.attach", "other_name")   # no match
+        assert plan.fire("shm.attach", "psm_abc")
+        assert plan.fire("shm.attach", "psm_def")
+        assert not plan.fire("shm.attach", "psm_ghi")      # budget spent
+        assert plan.shots_fired("shm.attach") == 2
+        assert not plan.fire("nan.obs")                    # unarmed point
+
+    def test_once_semantics_shared_across_instances(self, tmp_path):
+        # two instances over one scratch dir model parent + spawn worker:
+        # the budget is global, which is what lets a respawned worker
+        # converge instead of re-crashing forever
+        a = FaultPlan(str(tmp_path), {"writer.crash": {}})
+        b = FaultPlan(str(tmp_path), {"writer.crash": {}})
+        assert a.fire("writer.crash")
+        assert not b.fire("writer.crash")
+
+    def test_plan_is_picklable(self, tmp_path):
+        import pickle
+
+        plan = FaultPlan(str(tmp_path), {"writer.crash": {"times": 3}})
+        clone = pickle.loads(pickle.dumps(plan))
+        assert clone.spec == plan.spec
+        assert clone.scratch_dir == plan.scratch_dir
+
+
+class TestManifestDiffError:
+    def test_mismatch_names_fields_and_values(self, ens, tmp_path):
+        from psrsigsim_tpu.io.export import ExportManifestError
+
+        out = str(tmp_path / "m")
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                          chunk_size=2, writers=1)
+        with pytest.raises(ExportManifestError) as ei:
+            supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=2,
+                              chunk_size=2, writers=1)
+        err = ei.value
+        assert set(err.mismatches) == {"seed"}
+        assert err.mismatches["seed"] == (1, 2)
+        # the rendered message carries the field, both values, and a hint
+        assert "seed" in str(err) and "RNG seed differs" in str(err)
+
+    def test_multi_field_mismatch_lists_each(self, ens, tmp_path):
+        from psrsigsim_tpu.io.export import ExportManifestError
+
+        out = str(tmp_path / "m2")
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                          chunk_size=2, writers=1)
+        with pytest.raises(ExportManifestError) as ei:
+            supervised_export(ens, 3, out, TEMPLATE, ens.pulsar, seed=2,
+                              chunk_size=2, writers=1)
+        assert set(ei.value.mismatches) == {"seed", "n_obs"}
+
+    def test_corrupt_manifest_refuses_plain_resume(self, ens, tmp_path):
+        """A manifest that exists but cannot be parsed proves nothing
+        about the out_dir: resuming over it must fail loudly, not
+        silently keep whatever files are there (the ensemble-mixing bug
+        the manifest exists to prevent)."""
+        out = str(tmp_path / "c")
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                          chunk_size=2, writers=1)
+        with open(os.path.join(out, "export_manifest.json"), "w") as f:
+            f.write('{"n_obs": 2, "seed"')   # torn by external cause
+        with pytest.raises(RuntimeError, match="unreadable"):
+            supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                              chunk_size=2, writers=1)
+        # resume=False is the sanctioned way past it
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                          chunk_size=2, writers=1, resume=False)
+
+    def test_supervisor_extras_survive_matching_resume(self, ens, tmp_path):
+        out = str(tmp_path / "m3")
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                          chunk_size=2, writers=1)
+        man1 = json.load(open(os.path.join(out, "export_manifest.json")))
+        assert man1["files"]          # hashes recorded
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=1,
+                          chunk_size=2, writers=1)
+        man2 = json.load(open(os.path.join(out, "export_manifest.json")))
+        assert man2["files"] == man1["files"]
+
+
+class TestVerifiedResume:
+    def test_journal_and_manifest_record_true_hashes(self, ens, tmp_path):
+        import hashlib
+
+        out = str(tmp_path / "h")
+        res = supervised_export(ens, 3, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=3, writers=1)
+        for p in res.paths:
+            name = os.path.basename(p)
+            want = hashlib.sha256(open(p, "rb").read()).hexdigest()
+            assert res.hashes[name] == want
+        man = json.load(open(os.path.join(out, "export_manifest.json")))
+        assert man["files"] == res.hashes
+
+    def test_verify_rewrites_corrupt_file_bit_identically(self, ens,
+                                                          tmp_path):
+        out = str(tmp_path / "v")
+        res = supervised_export(ens, 3, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=3, writers=1)
+        blob = open(res.paths[1], "rb").read()
+        with open(res.paths[1], "wb") as f:
+            f.write(blob[:128])      # torn file: right name, wrong bytes
+        keep0 = os.path.getmtime(res.paths[0])
+        supervised_export(ens, 3, out, TEMPLATE, ens.pulsar, seed=0,
+                          chunk_size=3, writers=1, resume="verify")
+        assert open(res.paths[1], "rb").read() == blob
+        assert os.path.getmtime(res.paths[0]) == keep0   # others untouched
+
+    def test_plain_resume_trusts_existence(self, ens, tmp_path):
+        # the contrast case: without verify, a corrupt file is kept —
+        # which is exactly why verify mode exists
+        out = str(tmp_path / "nv")
+        res = supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=2, writers=1)
+        with open(res.paths[1], "wb") as f:
+            f.write(b"garbage")
+        supervised_export(ens, 2, out, TEMPLATE, ens.pulsar, seed=0,
+                          chunk_size=2, writers=1)
+        assert open(res.paths[1], "rb").read() == b"garbage"
+
+    def test_journal_replay_tolerates_torn_tail(self, tmp_path):
+        out = str(tmp_path / "j")
+        os.makedirs(out)
+        jpath = os.path.join(out, "run_journal.jsonl")
+        good = json.dumps({"e": "commit", "kind": "chunk", "ident": 0,
+                           "files": {"obs_00000.fits": "aa"}}) + "\n"
+        with open(jpath, "w") as f:
+            f.write(good)
+            f.write('{"e": "commit", "files": {"obs_00001.fits"')  # torn
+        sup = RunSupervisor(out, resume=True, verify=True)
+        assert sup._hashes == {"obs_00000.fits": "aa"}
+        # the torn tail is truncated away, so this run's appends start on
+        # a fresh line — NOT welded onto the fragment, which would make
+        # the NEXT resume drop every record after it
+        assert open(jpath).read() == good
+        sup.chunk_committed(("chunk", 1, ["obs_00001.fits"]),
+                            [("obs_00001.fits", "bb")])
+        sup2 = RunSupervisor(out, resume=True, verify=True)
+        assert sup2._hashes == {"obs_00000.fits": "aa",
+                                "obs_00001.fits": "bb"}
+
+    def test_bare_exporter_rejects_verify_mode(self, ens, tmp_path):
+        from psrsigsim_tpu.io import export_ensemble_psrfits
+
+        with pytest.raises(ValueError, match="verify"):
+            export_ensemble_psrfits(ens, 2, str(tmp_path / "x"), TEMPLATE,
+                                    ens.pulsar, resume="verify")
+
+    def test_resume_false_resets_journal_and_cursor(self, tmp_path):
+        out = str(tmp_path / "r")
+        os.makedirs(out)
+        for name in ("run_journal.jsonl", "run_cursor.json"):
+            with open(os.path.join(out, name), "w") as f:
+                f.write("stale")
+        RunSupervisor(out, resume=False)
+        assert not os.path.exists(os.path.join(out, "run_journal.jsonl"))
+        assert not os.path.exists(os.path.join(out, "run_cursor.json"))
+
+
+class TestFiniteMaskGuard:
+    def test_clean_run_is_all_finite(self, ens):
+        _, _, _, finite = ens.run_quantized(2, seed=0, return_finite=True)
+        assert np.asarray(finite).shape == (2, ens.cfg.meta.nchan)
+        assert np.asarray(finite).all()
+
+    def test_poisoned_norm_flags_exactly_that_observation(self, ens):
+        norms = np.ones(3, np.float64)
+        norms[1] = np.nan
+        _, _, _, finite = ens.run_quantized_at(
+            [0, 1, 2], seed=0, noise_norms=norms)
+        finite = np.asarray(finite)
+        assert finite[0].all() and finite[2].all()
+        assert not finite[1].any()
+
+    def test_iter_chunks_finite_mask_requires_quantized(self, ens):
+        with pytest.raises(ValueError, match="finite_mask"):
+            list(ens.iter_chunks(2, finite_mask=True))
+
+    def test_run_quantized_at_matches_main_pass(self, ens):
+        """The retry primitive with salt=None reproduces the main pass
+        bit-for-bit — the property that keeps resumed/grouped rewrites
+        byte-identical."""
+        d0, s0, o0 = (np.asarray(a) for a in ens.run_quantized(4, seed=9))
+        d1, s1, o1, _ = (np.asarray(a) for a in
+                         ens.run_quantized_at([1, 3], seed=9))
+        assert np.array_equal(d1[0], d0[1]) and np.array_equal(d1[1], d0[3])
+        assert np.array_equal(s1[0], s0[1]) and np.array_equal(o1[1], o0[3])
+
+    def test_fold_salt_changes_the_stream(self, ens):
+        d0, _, _, _ = ens.run_quantized_at([1], seed=9)
+        d1, _, _, m1 = ens.run_quantized_at([1], seed=9, fold_salt=0x7E7247)
+        assert np.asarray(m1).all()
+        assert not np.array_equal(np.asarray(d0), np.asarray(d1))
+
+
+class TestChunkSizeInvariance:
+    """Satellite: iter_chunks output must be invariant to chunk_size —
+    same seed => bit-identical concatenated observations — because PRNG
+    keys derive from GLOBAL observation indices, not chunk-local ones."""
+
+    N_OBS = 12
+
+    def _collect(self, ens, chunk_size):
+        blocks = {}
+        for start, (d, s, o) in ens.iter_chunks(
+                self.N_OBS, chunk_size=chunk_size, seed=5, quantized=True):
+            blocks[start] = tuple(np.asarray(a) for a in (d, s, o))
+        order = sorted(blocks)
+        return tuple(np.concatenate([blocks[k][c] for k in order])
+                     for c in range(3))
+
+    def test_bit_identical_across_chunk_sizes(self, ens):
+        ref = self._collect(ens, self.N_OBS)
+        for cs in (64, 256, 8):   # 64/256 clamp to n_obs; 8 genuinely
+            got = self._collect(ens, cs)   # changes the program width
+            for c, (a, b) in enumerate(zip(ref, got)):
+                assert a.shape == b.shape, (cs, c)
+                assert np.array_equal(a, b), (
+                    f"chunk_size={cs} component {c} not bit-identical")
+
+
+class TestPackedGroupQuarantine:
+    def test_bad_obs_in_packed_group_recovers_whole_group(self, ens,
+                                                          tmp_path):
+        """obs_per_file=2 with one poisoned observation: the group's file
+        is withheld on the main pass, healthy members re-run with their
+        ORIGINAL keys, and untouched groups stay byte-identical to a
+        clean export."""
+        clean = str(tmp_path / "clean")
+        rc = supervised_export(ens, 4, clean, TEMPLATE, ens.pulsar, seed=6,
+                               chunk_size=4, writers=1, obs_per_file=2)
+        out = str(tmp_path / "faulted")
+        plan = FaultPlan(str(tmp_path / "plan"),
+                         {"nan.obs": {"indices": [1]}})
+        res = supervised_export(ens, 4, out, TEMPLATE, ens.pulsar, seed=6,
+                                chunk_size=4, writers=1, obs_per_file=2,
+                                faults=plan)
+        assert res.retried == [1] and res.recovered == [1]
+        assert res.quarantined == []
+        assert len(res.paths) == 2 and all(map(os.path.exists, res.paths))
+        # group 1 (obs 2-3) never saw a fault: byte-identical
+        assert (open(res.paths[1], "rb").read()
+                == open(rc.paths[1], "rb").read())
+        # group 0 differs only through obs 1's fresh fold
+        assert (open(res.paths[0], "rb").read()
+                != open(rc.paths[0], "rb").read())
+
+
+class TestSimulationBridge:
+    def test_export_ensemble_routes_through_supervisor(self, tmp_path):
+        d = {
+            "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+            "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+            "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+            "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+            "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+            "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+            "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+            "seed": 8, "tempfile": TEMPLATE,
+        }
+        sim = Simulation(psrdict=d)
+        out = str(tmp_path / "bridge")
+        res = sim.export_ensemble(2, out, chunk_size=2, writers=1)
+        assert res.paths and all(map(os.path.exists, res.paths))
+        assert os.path.exists(os.path.join(out, "run_journal.jsonl"))
+
+    def test_export_ensemble_requires_template(self):
+        sim = Simulation(psrdict={"fcent": 1400.0})
+        with pytest.raises(RuntimeError, match="template"):
+            sim.export_ensemble(1, "/tmp/never")
