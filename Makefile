@@ -2,8 +2,11 @@
 # collected by the ordinary pytest run (tests/test_psrlint.py), and the
 # fault-injection suite carries the `faults` marker, so it runs inside
 # tier-1 (`make test`) AND is addressable on its own (`make test-faults`).
+# `make bench-export` is the quick streaming-export gate: pipelined vs
+# serial byte identity, pipeline >= serial throughput, stage timers
+# present, compute slope resolvable (bench.py export_smoke).
 
-.PHONY: lint test test-faults
+.PHONY: lint test test-faults bench-export
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -13,3 +16,6 @@ test:
 
 test-faults:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
+
+bench-export:
+	JAX_PLATFORMS=cpu PSS_BENCH_EXPORT_OBS=48 python bench.py --export-smoke

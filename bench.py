@@ -642,6 +642,63 @@ def time_tpu_ensemble(sim, dm):
     return slope / ENSEMBLE_BATCH, sync, sdiag
 
 
+def _export_compute_slope(ens, width):
+    """Marginal device seconds/obs of the export-shaped quantized program
+    via an ADAPTIVE two-width K-slope.
+
+    BENCH_r05 recorded ``compute_slope_ok: false`` for this probe: the
+    program is so fast (~33 us/obs) that the fixed (2, 18) widths put
+    only ~70 ms of real work between the two timings — under the relay's
+    per-call jitter, so the "slope" was noise, not a mis-behaving
+    program.  The fix is the same rule every other config already obeys
+    implicitly: the width difference must carry enough work to clear the
+    rep spread.  Here the upper width widens 4x (18 -> 66 -> 258) until
+    the slope resolves; the final widths are reported in the diag."""
+    from psrsigsim_tpu.parallel.mesh import OBS_AXIS as _OBS
+    from psrsigsim_tpu.utils.rng import stage_key as _stage_key
+
+    cfg = ens.cfg
+    # the raw sharded program (unlike run_quantized) does no batch
+    # padding: round the timing batch up to the obs-shard count
+    qn = width + (-width) % ens.mesh.shape[_OBS]
+    idxq = jnp.arange(qn)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def _run_quant_k(root, dms_q, norms_q, k):
+        # K back-to-back quantized chunks inside one program; the K-slope
+        # cancels the dispatch constant and the int16/float accumulators
+        # defeat DCE (see _timed_slope)
+        def body(i, accs):
+            keys = jax.vmap(
+                lambda j: _stage_key(jax.random.fold_in(root, i),
+                                     "user", j)
+            )(idxq)
+            d, sc, of, _ = ens._run_sharded_quantized(
+                keys, dms_q, norms_q, ens._profiles, ens._freqs,
+                ens._chan_ids)
+            return (accs[0] + d, accs[1] + sc, accs[2] + of)
+        z = (jnp.zeros((qn, cfg.nsub, cfg.meta.nchan, cfg.nph),
+                       jnp.int16),
+             jnp.zeros((qn, cfg.nsub, cfg.meta.nchan), jnp.float32),
+             jnp.zeros((qn, cfg.nsub, cfg.meta.nchan), jnp.float32))
+        return jax.lax.fori_loop(0, k, body, z)
+
+    dms_q = jnp.full((qn,), ens.dm, jnp.float32)
+    norms_q = jnp.full((qn,), ens.noise_norm, jnp.float32)
+
+    def call(k, s):
+        return _run_quant_k(jax.random.key(s), dms_q, norms_q, k)
+
+    k1, k2 = 2, 18
+    while True:
+        slope, _, sdiag = _timed_slope(call, k1, k2)
+        if sdiag["slope_ok"] or k2 >= 258:
+            break
+        k2 = k1 + 4 * (k2 - k1)
+    sdiag["k_widths"] = [k1, k2]
+    return slope / qn, sdiag
+
+
 def time_export_e2e(n_obs=None):
     """End-to-end export: simulate -> device int16 quantize -> host
     transfer -> PSRFITS files on disk (the full north-star exit path,
@@ -677,9 +734,17 @@ def time_export_e2e(n_obs=None):
     ens = sim.to_ensemble(mesh=make_mesh((n_dev, 1)))
     tmpl = FitsFile.read(os.path.join(
         REPO, "data", "B1855+09.L-wide.PUPPI.11y.x.sum.sm"))
-    chunk = min(128, n_obs)
+    # chunk width doubled vs r05 (128): the streaming pipeline pays the
+    # relay's fixed per-transfer cost once per chunk, so fewer, larger
+    # chunks amortize it further (one fused buffer per chunk either way);
+    # ~135 MB device payload per chunk at this geometry, times ~depth+2
+    # chunks resident on host — override if a host is memory-tight
+    chunk = min(int(os.environ.get("PSS_BENCH_EXPORT_CHUNK", "256")), n_obs)
     bytes_per_obs = cfg.meta.nchan * cfg.nsamp * 2 + cfg.nsub * cfg.meta.nchan * 8
 
+    from psrsigsim_tpu.runtime import StageTimers
+
+    pipeline_depth = 2
     out_dir = tempfile.mkdtemp(prefix="pss_export_bench_")
     # packed mode: observations per PSRFITS file; capped by the chunk so
     # the component loops below can slice one fetched chunk into groups
@@ -691,66 +756,48 @@ def time_export_e2e(n_obs=None):
         # timed region paying the compile
         export_ensemble_psrfits(ens, chunk, out_dir + "/warm", tmpl,
                                 ens.pulsar, seed=0, chunk_size=chunk,
-                                resume=False)
+                                resume=False,
+                                pipeline_depth=pipeline_depth)
+        tel = StageTimers()
         t0 = time.perf_counter()
         export_ensemble_psrfits(ens, n_obs, out_dir + "/run", tmpl,
                                 ens.pulsar, seed=0, chunk_size=chunk,
-                                resume=False)
+                                resume=False,
+                                pipeline_depth=pipeline_depth,
+                                telemetry=tel)
         t_e2e = time.perf_counter() - t0
         e2e_obs_per_sec = n_obs / t_e2e
+        stage_timers = tel.snapshot()
 
         # packed mode: obs_per_file observations as SUBINT rows of one
         # file — identical bytes per observation, 1/opf the files
         shutil.rmtree(out_dir + "/run", ignore_errors=True)
+        tel_packed = StageTimers()
         t0 = time.perf_counter()
         export_ensemble_psrfits(ens, n_obs, out_dir + "/runp", tmpl,
                                 ens.pulsar, seed=0, chunk_size=chunk,
-                                resume=False, obs_per_file=opf)
+                                resume=False, obs_per_file=opf,
+                                pipeline_depth=pipeline_depth,
+                                telemetry=tel_packed)
         t_e2e_packed = time.perf_counter() - t0
         e2e_packed_obs_per_sec = n_obs / t_e2e_packed
+        stage_timers_packed = tel_packed.snapshot()
         shutil.rmtree(out_dir + "/runp", ignore_errors=True)
 
         # -- components --------------------------------------------------
-        # device compute only (no fetch): K back-to-back quantized chunks
-        # inside one program; the K-slope cancels the dispatch constant
-        # and the int16/float accumulators defeat DCE (see _timed_slope)
-        from psrsigsim_tpu.parallel.mesh import OBS_AXIS as _OBS
-        from psrsigsim_tpu.utils.rng import stage_key as _stage_key
+        # device compute only (no fetch): adaptive K-slope (see
+        # _export_compute_slope — BENCH_r05's fixed widths were swamped
+        # by relay jitter and reported compute_slope_ok: false)
+        t_compute, sdiag = _export_compute_slope(ens, chunk)
 
-        # the raw sharded program (unlike run_quantized) does no batch
-        # padding: round the timing batch up to the obs-shard count
-        qn = chunk + (-chunk) % ens.mesh.shape[_OBS]
-        idxq = jnp.arange(qn)
-
-        @partial(jax.jit, static_argnames=("k",))
-        def _run_quant_k(root, dms_q, norms_q, k):
-            def body(i, accs):
-                keys = jax.vmap(
-                    lambda j: _stage_key(jax.random.fold_in(root, i),
-                                         "user", j)
-                )(idxq)
-                d, sc, of, _ = ens._run_sharded_quantized(
-                    keys, dms_q, norms_q, ens._profiles, ens._freqs,
-                    ens._chan_ids)
-                return (accs[0] + d, accs[1] + sc, accs[2] + of)
-            z = (jnp.zeros((qn, cfg.nsub, cfg.meta.nchan, cfg.nph),
-                           jnp.int16),
-                 jnp.zeros((qn, cfg.nsub, cfg.meta.nchan), jnp.float32),
-                 jnp.zeros((qn, cfg.nsub, cfg.meta.nchan), jnp.float32))
-            return jax.lax.fori_loop(0, k, body, z)
-
-        dms_q = jnp.full((qn,), ens.dm, jnp.float32)
-        norms_q = jnp.full((qn,), ens.noise_norm, jnp.float32)
-        slope, _, sdiag = _timed_slope(
-            lambda k, s: _run_quant_k(jax.random.key(s), dms_q, norms_q, k),
-            2, 18,
-        )
-        t_compute = slope / qn
-
-        # link: one chunk's device->host fetch.  The big-endian program is
-        # the exporter's private transport encoding (run_quantized no
-        # longer exposes byte_order — ADVICE r5 #3), so drive it the way
-        # iter_chunks does: prepped inputs into the BE-swapped program.
+        # link: one chunk's device->host fetch, both transports.  The
+        # big-endian programs are the exporter's private transport
+        # encoding (run_quantized no longer exposes byte_order — ADVICE
+        # r5 #3), so drive them the way iter_chunks does: prepped inputs
+        # into the BE-swapped programs.  "separate" is the pre-pipeline
+        # three-transfer triple; "fused" is the streaming exporter's
+        # single packed buffer (data+scl+offs), which dodges two of the
+        # three per-transfer fixed costs on relay links.
         keys_q, dms_c, norms_c, pad_q = ens._prep_inputs(chunk, 4, None, None)
         dev = ens._run_sharded_quantized_be(
             keys_q, dms_c, norms_c, ens._profiles, ens._freqs,
@@ -762,6 +809,17 @@ def time_export_e2e(n_obs=None):
         host = jax.device_get(dev)
         t_fetch = time.perf_counter() - t0
         link_mbps = chunk * bytes_per_obs / t_fetch / 1e6
+
+        packed_dev, _ = ens._run_sharded_quantized_packed_be(
+            keys_q, dms_c, norms_c, ens._profiles, ens._freqs,
+            ens._chan_ids)
+        packed_dev = packed_dev[:chunk] if pad_q else packed_dev
+        jax.block_until_ready(packed_dev)
+        t0 = time.perf_counter()
+        _fused_host = jax.device_get(packed_dev)
+        t_fetch_fused = time.perf_counter() - t0
+        link_fused_mbps = chunk * bytes_per_obs / t_fetch_fused / 1e6
+        del _fused_host, packed_dev
 
         # host write only (disk) through the exporter's real per-file
         # path (the byte-prototype fast writer after file 0); the full
@@ -900,14 +958,27 @@ def time_export_e2e(n_obs=None):
         "e2e_packed_obs_per_sec": round(e2e_packed_obs_per_sec, 2),
         "packed_speedup": round(e2e_packed_obs_per_sec * t_cpu, 2),
         # the relay link rate, expressed per observation.  Measured on a
-        # single blocking fetch; the streamed e2e runs (prefetch=1) can
-        # land above or below it because the relay's rate wanders run to
-        # run — it contextualizes the in-tunnel numbers, which are
-        # transfer-bound whenever it is the smallest rate in this dict
+        # single blocking fetch; the streamed e2e runs can land above or
+        # below it because the relay's rate wanders run to run — it
+        # contextualizes the in-tunnel numbers, which are transfer-bound
+        # whenever it is the smallest rate in this dict.  "fused" is the
+        # streaming pipeline's actual transport (one packed buffer per
+        # chunk vs the triple's three transfers).
         "link_single_fetch_obs_per_sec": round(
             link_mbps * 1e6 / bytes_per_obs, 2),
+        "link_fused_fetch_obs_per_sec": round(
+            link_fused_mbps * 1e6 / bytes_per_obs, 2),
+        "link_fused_mb_per_sec": round(link_fused_mbps, 2),
+        # streaming-pipeline telemetry: per-stage busy seconds from the
+        # timed e2e runs — the bottleneck stage is now NAMED in every
+        # record instead of reverse-engineered from the component rates
+        "pipeline_depth": pipeline_depth,
+        "stage_timers": stage_timers,
+        "stage_timers_packed": stage_timers_packed,
+        "bottleneck_stage": stage_timers["bottleneck"],
         "device_compute_s_per_obs": round(t_compute, 6),
         "compute_slope_ok": sdiag["slope_ok"],
+        "compute_slope_k_widths": sdiag["k_widths"],
         "host_write_s_per_obs": round(t_write, 6),
         "host_write_full_pipeline_s_per_obs": round(t_write_full, 6),
         "host_write_packed_s_per_obs": round(t_write_packed, 6),
@@ -927,6 +998,109 @@ def time_export_e2e(n_obs=None):
         "machinery_speedup": round(proj_mach * t_cpu, 2),
         "machinery_needs_disk_mb_per_sec": round(
             proj_mach * bytes_per_obs / 1e6, 1),
+    }
+
+
+def export_smoke(n_obs=None):
+    """Quick export-pipeline smoke (``make bench-export``): a small
+    export run strictly serially (``pipeline_depth=0``) and pipelined
+    (depth 2) must (a) produce byte-identical files, (b) not lose
+    throughput to the pipeline machinery, (c) land stage timers in the
+    manifest, and (d) resolve the device-compute slope
+    (``compute_slope_ok`` — asserted here so a regression to BENCH_r05's
+    unresolvable probe fails CI instead of shipping as a flag in JSON).
+
+    Runs on whatever platform jax has (CPU in CI); asserts invariants,
+    not absolute rates.
+    """
+    import hashlib
+    import shutil
+    import tempfile
+
+    from psrsigsim_tpu.io import export_ensemble_psrfits
+    from psrsigsim_tpu.io.fits import FitsFile
+    from psrsigsim_tpu.parallel import make_mesh
+    from psrsigsim_tpu.runtime import StageTimers
+
+    if n_obs is None:
+        n_obs = int(os.environ.get("PSS_BENCH_EXPORT_OBS", "48"))
+    sim, cfg, profiles, noise_norm, freqs = build_workload(
+        nchan=64, period_s=0.005, samprate_mhz=0.1024, sublen_s=2.0,
+        tobs_s=16.0, fcent=1380.0, bw=400.0, smean=0.009, dm=15.9,
+    )
+    n_dev = len(jax.devices())
+    ens = sim.to_ensemble(mesh=make_mesh((n_dev, 1)))
+    tmpl = FitsFile.read(os.path.join(
+        REPO, "data", "B1855+09.L-wide.PUPPI.11y.x.sum.sm"))
+    chunk = max(n_dev, min(16, n_obs // 3))  # several chunks in flight
+
+    def _sha_set(paths):
+        return {os.path.basename(p):
+                hashlib.sha256(open(p, "rb").read()).hexdigest()
+                for p in paths}
+
+    out_dir = tempfile.mkdtemp(prefix="pss_export_smoke_")
+    try:
+        # warmup compiles both transports at the real chunk width
+        export_ensemble_psrfits(ens, chunk, out_dir + "/warm", tmpl,
+                                ens.pulsar, seed=0, chunk_size=chunk,
+                                resume=False, pipeline_depth=2)
+        t0 = time.perf_counter()
+        serial = export_ensemble_psrfits(
+            ens, n_obs, out_dir + "/serial", tmpl, ens.pulsar, seed=0,
+            chunk_size=chunk, resume=False, pipeline_depth=0)
+        t_serial = time.perf_counter() - t0
+        tel = StageTimers()
+        t0 = time.perf_counter()
+        piped = export_ensemble_psrfits(
+            ens, n_obs, out_dir + "/piped", tmpl, ens.pulsar, seed=0,
+            chunk_size=chunk, resume=False, pipeline_depth=2,
+            telemetry=tel)
+        t_piped = time.perf_counter() - t0
+
+        # (a) byte identity, via the per-file sha256 sets
+        sha_serial, sha_piped = _sha_set(serial), _sha_set(piped)
+        assert sha_serial == sha_piped, (
+            "pipelined export is not byte-identical to the serial path")
+
+        # (b) throughput: the pipeline must not be slower than serial
+        # (15% tolerance absorbs timer noise at smoke sizes — the point
+        # is catching a pipeline that SERIALIZES, which shows up as the
+        # queue/thread overhead stacking onto an unchanged critical path)
+        assert t_piped <= 1.15 * t_serial, (
+            f"pipelined export slower than serial: {t_piped:.2f}s vs "
+            f"{t_serial:.2f}s")
+
+        # (c) stage timers present, in the run AND its manifest
+        snap = tel.snapshot()
+        for stage in ("dispatch", "fetch", "encode", "write"):
+            assert snap[f"{stage}_s"] >= 0.0 and snap[f"{stage}_calls"] > 0, \
+                f"stage {stage} never reported"
+        assert snap["bytes_fetched"] > 0
+        with open(os.path.join(out_dir, "piped",
+                               "export_manifest.json")) as f:
+            man = json.load(f)
+        assert "pipeline" in man and man["pipeline"]["depth"] == 2, (
+            "manifest lacks pipeline telemetry")
+
+        # (d) the compute slope must resolve
+        t_compute, sdiag = _export_compute_slope(ens, chunk)
+        assert sdiag["slope_ok"], f"compute slope unresolved: {sdiag}"
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+    return {
+        "metric": "export_smoke",
+        "n_obs": n_obs,
+        "chunk_size": chunk,
+        "serial_obs_per_sec": round(n_obs / t_serial, 2),
+        "pipelined_obs_per_sec": round(n_obs / t_piped, 2),
+        "pipeline_over_serial": round(t_serial / t_piped, 3),
+        "device_compute_s_per_obs": round(t_compute, 6),
+        "compute_slope_ok": sdiag["slope_ok"],
+        "stage_timers": snap,
+        "bottleneck_stage": snap["bottleneck"],
+        "ok": True,
     }
 
 
@@ -1008,6 +1182,12 @@ def _checkpoint(detail):
 def main():
     # keep stdout clean for the single JSON result line: the OO layer's
     # reference-parity warnings (sub-Nyquist sampling etc.) print to stdout
+    if "--export-smoke" in sys.argv[1:]:
+        # `make bench-export`: the quick pipelined-vs-serial export gate
+        with contextlib.redirect_stdout(sys.stderr):
+            result = export_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
     with contextlib.redirect_stdout(sys.stderr):
         result = _main()
     print(json.dumps(result), file=_REAL_STDOUT, flush=True)
@@ -1149,7 +1329,9 @@ def _main():
     detail["export_e2e"] = exp
     log(f"export_e2e: {exp['e2e_obs_per_sec']:.1f} obs/s per-file, "
         f"{exp['e2e_packed_obs_per_sec']:.1f} obs/s packed x{exp['obs_per_file']} "
-        f"(single-fetch link {exp['link_single_fetch_obs_per_sec']:.1f} obs/s) "
+        f"(bottleneck: {exp['bottleneck_stage']}; link single-fetch "
+        f"{exp['link_single_fetch_obs_per_sec']:.1f} obs/s, fused "
+        f"{exp['link_fused_fetch_obs_per_sec']:.1f} obs/s) "
         f"vs cpu {1/exp['cpu_s_per_obs']:.2f} obs/s -> "
         f"{exp['packed_speedup']:.2f}x in-tunnel; direct-attach packed "
         f"{exp['projected_direct_attach_packed_obs_per_sec']:.0f} obs/s "

@@ -12,14 +12,20 @@ like this exists in the reference — its save path handles one in-memory
 signal at a time (reference: io/psrfits.py:305-424,
 simulate/simulate.py:328-377).
 
-Three stages overlap: the device computes chunk N+1 (``prefetch`` in
-:meth:`FoldEnsemble.iter_chunks`) while chunk N crosses the host link and
-chunk N-1's files are written.  File writing itself parallelizes across
+The export is a bounded-depth streaming pipeline (``pipeline_depth``):
+the device computes chunk N+1 (``prefetch`` dispatch-ahead in
+:meth:`FoldEnsemble.iter_chunks`) while a dedicated fetch thread pulls
+chunk N over the host link as ONE fused device buffer
+(data+scales+offsets packed on-device) and chunk N-1's files are
+encoded/written — so the device, the link and the disk are all busy at
+once, with bounded queues giving backpressure and preserving the serial
+commit/journal order.  File writing itself parallelizes across
 ``writers`` processes (spawn workers fed through shared memory, one
 memcpy per chunk) — PSRFITS assembly is Python/GIL-bound per file, so on
 multi-core TPU hosts the writer pool is what keeps the exit path off the
 critical path.  ``writers=1`` (the default on single-core hosts) writes
-in-process.
+in-process.  Per-stage telemetry (dispatch/fetch/encode/write, queue
+depths, bytes) accumulates into the export manifest's ``pipeline`` key.
 
 Resume correctness: chunk PRNG keys derive from GLOBAL observation
 indices, so re-running the same export skips finished files and produces
@@ -108,7 +114,13 @@ def _writer_init(payload):  # psrlint: disable=PSR105 (spawn-worker init: per-pr
     activated via ``ephem.set_ephemeris(path)`` (tutorial 8's API path)
     would silently NOT apply to worker-written files — only the
     ``PSS_EPHEM`` env var survives a spawn — so the parent's active
-    source rides along in the pickled state (advisor round 4)."""
+    source rides along in the pickled state (advisor round 4).  The
+    parent's measured native-encode probe verdicts ride along the same
+    way (``native_probe``): without them every worker would either re-pay
+    the per-size speed probe or — worse — silently never enable the
+    compiled encoder the parent already proved faster (BENCH_r05
+    ``io_encode``: 4.2x encode win measured, yet
+    ``native_encode_selected: false``)."""
     global _worker_state
     _worker_state = pickle.loads(payload)
     src = _worker_state.get("ephemeris_source")
@@ -116,6 +128,9 @@ def _writer_init(payload):  # psrlint: disable=PSR105 (spawn-worker init: per-pr
         from . import ephem
 
         ephem.set_ephemeris(src)
+    from . import native
+
+    native.seed_probe_state(_worker_state.get("native_probe"))
 
 
 def _attach_chunk(shm_name, meta, faults=None):
@@ -145,6 +160,10 @@ def _write_obs_full(state, path, triple, dm):
     subintegration cadence, OFFS_SUB continuing across the file, polyco
     segments spanning the full duration (PSRFITS.save already fits one
     segment per segLength minutes)."""
+    import time as _time
+
+    timers = state.get("timers")
+    t0 = _time.perf_counter()
     sig = state["sig"]
     if dm is not None:
         sig._dm = make_quant(float(dm), "pc/cm^3")
@@ -162,6 +181,12 @@ def _write_obs_full(state, path, triple, dm):
               MJD_start=state["MJD_start"], ref_MJD=state["ref_MJD"],
               quantized=triple, verbose=False)
     os.replace(tmp, path)
+    if timers is not None:
+        # the rare full-assembly writes (prototype priming, per-obs DMs)
+        # count wholly as "write": their cost is dominated by FITS
+        # assembly + the write itself, and splitting them would not
+        # change which stage the telemetry names as the bottleneck
+        timers.add("write", _time.perf_counter() - t0)
 
 
 class _FastObsWriter:
@@ -190,6 +215,8 @@ class _FastObsWriter:
         """Write one file; returns its sha256 when the state records
         hashes AND the fast path had the payload in memory (None
         otherwise — the caller falls back to hashing the file)."""
+        import time as _time
+
         if dm is not None:
             # per-observation DMs patch headers too: keep the one full
             # pipeline as the single source of truth for that rare path
@@ -201,6 +228,8 @@ class _FastObsWriter:
             _write_obs_full(self._state, path, triple, dm)
             self._protos[shape] = self._init_proto(path)
             return None
+        timers = self._state.get("timers")
+        t0 = _time.perf_counter()
         pre, sub, post, pad = proto
         q_data, q_scl, q_offs = (np.asarray(a) for a in triple)
         arr = sub.data
@@ -216,13 +245,21 @@ class _FastObsWriter:
                 f"quantized scl/offs shapes {q_scl.shape}/{q_offs.shape} "
                 f"!= {(nsub, nchan)}")
         # broadcast across pols exactly as PSRFITS.save's row assignment
-        # does (numpy converts to the on-disk '>i2' in place)
+        # does (numpy converts to the on-disk '>i2' in place); npol==1
+        # (every generated payload) skips the tile copies outright
         arr["DATA"][:] = q_data[:, None, :, :]
-        arr["DAT_SCL"] = np.tile(q_scl, (1, npol))
-        arr["DAT_OFFS"] = np.tile(q_offs, (1, npol))
+        if npol == 1:
+            arr["DAT_SCL"] = q_scl
+            arr["DAT_OFFS"] = q_offs
+        else:
+            arr["DAT_SCL"] = np.tile(q_scl, (1, npol))
+            arr["DAT_OFFS"] = np.tile(q_offs, (1, npol))
         tmp = path + ".tmp"
         bufs = [pre, arr.view(np.uint8).reshape(-1), pad, post]
         total = sum(len(b) for b in bufs)
+        if timers is not None:
+            timers.add("encode", _time.perf_counter() - t0)
+            t0 = _time.perf_counter()
         if should_fire(self._state.get("faults"), "file.partial", path):
             # model a power-cut/SIGKILL mid-write: half the payload lands
             # in the temp file, then the writing process dies without
@@ -250,14 +287,17 @@ class _FastObsWriter:
             raise
         os.close(fd)
         os.replace(tmp, path)
+        sha = None
         if self._state.get("hash_files"):
             # the bufs ARE the file bytes just written: hash them in
             # memory instead of re-reading a multi-GB run back from disk
             h = hashlib.sha256()
             for b in bufs:
                 h.update(b)
-            return h.hexdigest()
-        return None
+            sha = h.hexdigest()
+        if timers is not None:
+            timers.add("write", _time.perf_counter() - t0)
+        return sha
 
     def _init_proto(self, path):
         from .fits import BLOCK
@@ -394,10 +434,12 @@ class _WriterPool:
 
     def __init__(self, n_writers, payload, state, startup_timeout=120.0,
                  respawn_policy=None, max_pool_deaths=3, job_retries=2,
-                 on_chunk_done=None):
+                 on_chunk_done=None, timers=None):
         self.n = n_writers
         self._payload = payload
         self._state = state  # parent-side writer state for serial fallback
+        self._timers = timers  # parent-side StageTimers (encode = shm
+        #                        memcpy, write = blocked wait on workers)
         self._timeout = startup_timeout
         self._policy = respawn_policy or RetryPolicy(
             max_attempts=3, base_delay=0.25, max_delay=5.0)
@@ -511,6 +553,8 @@ class _WriterPool:
     # -- submission / drain ------------------------------------------------
 
     def submit_chunk(self, triple, jobs, token=None):
+        import time as _time
+
         from concurrent.futures import BrokenExecutor
         from multiprocessing import shared_memory
 
@@ -521,13 +565,17 @@ class _WriterPool:
             # writes them serially out of their shm blocks)
             while self._inflight:
                 self._drain_oldest()
-            arrays = tuple(np.ascontiguousarray(a) for a in triple)
+            arrays = tuple(np.asarray(a) for a in triple)
             self._notify(token, _serial_write_jobs(self._state, arrays, jobs))
             return
-        data, scl, offs = (np.ascontiguousarray(a) for a in triple)
+        # np.asarray, NOT ascontiguousarray: the copy into the shared
+        # block below handles strided sources (the fused-transport data
+        # view), and a contiguity pre-copy would double the memcpy
+        data, scl, offs = (np.asarray(a) for a in triple)
         nbytes = data.nbytes + scl.nbytes + offs.nbytes
         shm = shared_memory.SharedMemory(create=True, size=max(nbytes, 1))
         try:
+            t0 = _time.perf_counter()
             off = 0
             meta = []
             for a in (data, scl, offs):
@@ -538,6 +586,8 @@ class _WriterPool:
                 meta.append((a.shape, a.dtype.str))
                 off += a.nbytes
                 del view
+            if self._timers is not None:
+                self._timers.add("encode", _time.perf_counter() - t0)
             step = max(1, -(-len(jobs) // self.n))
             batches = [jobs[k:k + step] for k in range(0, len(jobs), step)]
             while True:
@@ -627,7 +677,15 @@ class _WriterPool:
                 pending.pop(0)
                 continue
             try:
-                results.extend(item["fut"].result())
+                import time as _time
+
+                t0 = _time.perf_counter()
+                batch = item["fut"].result()
+                if self._timers is not None:
+                    # parent-side wait on the workers IS the pipeline's
+                    # write-stage cost (worker internals hide under it)
+                    self._timers.add("write", _time.perf_counter() - t0)
+                results.extend(batch)
             except BrokenExecutor as err:
                 self._handle_pool_death(err, entry)
                 continue
@@ -823,7 +881,9 @@ class _GroupPacker:
     def __init__(self, n_obs, obs_per_file):
         self.n_obs = int(n_obs)
         self.opf = int(obs_per_file)
-        self._buf = {}   # group index -> [per-obs triple COPIES or None]
+        # group index -> [preallocated (data, scl, offs) buffers, filled
+        # bool-per-obs]; buffers are handed out on completion, never reused
+        self._buf = {}
 
     def group_span(self, g):
         first = g * self.opf
@@ -833,10 +893,14 @@ class _GroupPacker:
         """Feed one fetched chunk; yield ``(group_index, packed_triple)``
         for every group the chunk completes.
 
-        A group wholly inside the chunk packs as a zero-copy reshape of
-        the chunk arrays; only boundary-straddling groups buffer — and
-        they buffer per-observation COPIES, so a pending group never pins
-        the whole previous chunk's arrays in memory.
+        A group wholly inside the chunk packs as a reshape of the chunk
+        arrays; only boundary-straddling groups buffer — into
+        preallocated contiguous per-group buffers filled by ONE slice
+        assignment per overlapping chunk (BENCH_r05 found the previous
+        per-observation ``.copy()`` + ``np.concatenate`` scheme costing
+        6.7 ms/obs against 2.5 ms for the whole unpacked write path), so
+        a pending group never pins the previous chunk's arrays and its
+        completion yield is a zero-copy reshape of its own buffer.
 
         ``skip_group``: optional predicate ``skip_group(g) -> bool``; a
         True group is neither buffered nor yielded.  The resuming
@@ -860,15 +924,23 @@ class _GroupPacker:
                     a[sl].reshape((size * a.shape[1],) + a.shape[2:])
                     for a in (data, scl, offs))
                 continue
-            slot = self._buf.setdefault(g, [None] * size)
-            for i in range(lo, hi):
-                j = i - start
-                slot[i - first] = (data[j].copy(), scl[j].copy(),
-                                   offs[j].copy())
-            if all(p is not None for p in slot):
+            slot = self._buf.get(g)
+            if slot is None:
+                slot = self._buf[g] = (
+                    tuple(np.empty((size,) + a.shape[1:], a.dtype)
+                          for a in (data, scl, offs)),
+                    np.zeros(size, bool))
+            bufs, filled = slot
+            src = slice(lo - start, hi - start)
+            dst = slice(lo - first, hi - first)
+            for buf, a in zip(bufs, (data, scl, offs)):
+                buf[dst] = a[src]
+            filled[dst] = True
+            if filled.all():
                 del self._buf[g]
-                parts = list(zip(*slot))
-                yield g, tuple(np.concatenate(p, axis=0) for p in parts)
+                yield g, tuple(
+                    b.reshape((size * b.shape[1],) + b.shape[2:])
+                    for b in bufs)
 
 
 def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
@@ -876,7 +948,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                             chunk_size=256, progress=None, resume=True,
                             parfile=None, MJD_start=56000.0,
                             ref_MJD=56000.0, writers=None,
-                            obs_per_file=1, supervisor=None, faults=None):
+                            obs_per_file=1, supervisor=None, faults=None,
+                            pipeline_depth=2, telemetry=None):
     """Export ``n_obs`` ensemble observations as PSRFITS files.
 
     Args:
@@ -927,10 +1000,34 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         faults: optional :class:`psrsigsim_tpu.runtime.FaultPlan` —
             deterministic fault injection for tests; never armed unless a
             plan is passed explicitly.
+        pipeline_depth: depth of the streaming export pipeline (default
+            2).  With depth N the four stages overlap fully — the device
+            dispatches chunk k+1 while a dedicated fetch thread pulls
+            chunk k over the link (ONE fused buffer per chunk) and the
+            writers encode/write chunk k-1 — with bounded queues of N
+            chunks between device/fetch and fetch/write, so host memory
+            holds at most ~N+2 chunks and commit/journal ordering is
+            exactly the serial order.  ``pipeline_depth=0`` restores the
+            strictly inline dispatch->fetch->write loop (the baseline the
+            byte-identity tests compare against); output bytes are
+            identical at every depth.
+        telemetry: optional
+            :class:`psrsigsim_tpu.runtime.StageTimers`; one is created
+            internally otherwise.  Per-stage busy times
+            (dispatch/fetch/encode/write), fetched bytes and queue depths
+            are accumulated there and folded into the export manifest
+            under ``"pipeline"``.
 
     Returns:
         list of the output file paths (length ``ceil(n_obs/obs_per_file)``).
     """
+    from ..runtime.telemetry import StageTimers
+
+    pipeline_depth = int(pipeline_depth)
+    if pipeline_depth < 0:
+        raise ValueError("pipeline_depth must be >= 0")
+    if telemetry is None:
+        telemetry = StageTimers()
     if resume == "verify" and supervisor is None:
         # hash-verified resume is a supervisor capability; silently
         # downgrading to exists-only resume would ship the very torn
@@ -1028,7 +1125,10 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
              # supervised runs journal per-file sha256; fault plans ride
              # to workers inside the same pickled state
              "hash_files": supervisor is not None,
-             "faults": faults}
+             "faults": faults,
+             # parent-side stage timers: NOT shipped to spawn workers
+             # (worker cost surfaces as the parent's write-stage wait)
+             "timers": telemetry}
     dms_np = None if dms is None else np.asarray(dms, np.float64)
 
     # the supervisor journals a chunk the moment its files are durably
@@ -1039,9 +1139,24 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
 
     pool = None
     if writers > 1:
+        from . import native as _native
+
+        # spawn workers carry the parent's write context minus the
+        # unpicklable parent-side timers, plus the parent's measured
+        # native-encode probe verdicts (see _writer_init).  Prime the
+        # CHEAP probes first so the snapshot is meaningful in a fresh
+        # process: encode_available() builds/publishes the cached .so
+        # (workers dlopen it instead of racing N concurrent g++ builds)
+        # and settles int16 cast parity.  The expensive per-size speed
+        # probe stays lazy — the pooled quantized path never
+        # float-encodes, so paying it up front would tax every export
+        # for a path the workers may never hit
+        _native.encode_available()
+        worker_state = {k: v for k, v in state.items() if k != "timers"}
+        worker_state["native_probe"] = _native.probe_state()
         try:
-            pool = _WriterPool(writers, pickle.dumps(state), state,
-                               on_chunk_done=commit)
+            pool = _WriterPool(writers, pickle.dumps(worker_state), state,
+                               on_chunk_done=commit, timers=telemetry)
         except Exception as err:  # pragma: no cover - environment-dependent
             import warnings
 
@@ -1070,6 +1185,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             noise_norms=norms_main, quantized=True, progress=progress,
             skip_chunk=skip, byte_order="big",
             finite_mask=supervisor is not None,
+            prefetch=max(1, pipeline_depth), fetch_ahead=pipeline_depth,
+            timers=telemetry,
         ):
             if supervisor is not None:
                 data, scl, offs, finite = block
@@ -1148,6 +1265,20 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
                            n_obs, seed, dms, noise_norms, obs_per_file,
                            dms_np)
+
+    # fold the run's stage telemetry into the manifest so every export
+    # names its own bottleneck (supervisor.finalize preserves the key).
+    # A fully-resumed no-op run records nothing: it must not replace the
+    # real run's durable record with an all-zero snapshot
+    snap = telemetry.snapshot()
+    if any(snap[f"{s}_calls"] for s in ("dispatch", "fetch", "encode",
+                                        "write")):
+        man = _load_manifest(out_dir)
+        if man is not None:
+            man["pipeline"] = {"depth": pipeline_depth,
+                               "writers": int(writers),
+                               "chunk_size": int(chunk_size), **snap}
+            _write_manifest(out_dir, man)
     return paths
 
 

@@ -36,6 +36,30 @@ except AttributeError:  # pragma: no cover
 __all__ = ["FoldEnsemble", "MultiPulsarFoldEnsemble"]
 
 
+def _split_packed_chunk(packed, nbin):
+    """Host-side inverse of the fused-transport packing: one fetched
+    ``(count, nsub, C, nbin+4)`` int16 buffer back into the
+    ``(data, scl, offs)`` triple.
+
+    ``data`` is returned as a zero-copy view into the fetched buffer (the
+    consumers either slice-assign or memcpy it onward anyway); the tail's
+    8 bytes per (subint, channel) are made contiguous — a copy that is
+    ``8/(2*nbin)`` of the payload — and reinterpreted as the two float32
+    columns, bit-exactly as the device produced them."""
+    packed = np.asarray(packed)
+    data = packed[..., :nbin]
+    tail = np.ascontiguousarray(packed[..., nbin:]).view(np.float32)
+    return data, tail[..., 0], tail[..., 1]
+
+
+def _block_nbytes(block):
+    """Total payload bytes of a fetched chunk (tuple of arrays or one
+    array) — the fetch-stage telemetry's bytes counter."""
+    if isinstance(block, (tuple, list)):
+        return sum(np.asarray(a).nbytes for a in block)
+    return np.asarray(block).nbytes
+
+
 def _check_hetero_nfolds(nfolds):
     """The hetero pipeline traces its chi2 df (= Nfold per pulsar), so
     draws go through the Wilson-Hilferty path unconditionally
@@ -170,6 +194,48 @@ class FoldEnsemble:
 
         self._run_sharded_quantized_be = jax.jit(
             shard_map(_local_quantized_be, **_quant_specs)
+        )
+
+        def _pack_triple(d, s, o):
+            # fuse (data, scl, offs) into ONE int16 buffer per chunk so
+            # the streaming exporter's fetch stage is a single contiguous
+            # device->host transfer: on the relay links this repo benches
+            # against, each transfer carries a large fixed cost (BENCH_r04
+            # measured ~0.5 s/dispatch), so three per chunk is two too
+            # many.  scl/offs ride along bitcast to int16 pairs appended
+            # on the bin axis — (B, nsub, C, nbin+4) — and the host
+            # recovers them exactly by reinterpreting the tail bytes
+            # (ensemble._split_packed_chunk); bitcast is bit-exact, so the
+            # unpacked triple is identical to the unfused programs'.
+            s2 = jax.lax.bitcast_convert_type(s, jnp.int16)
+            o2 = jax.lax.bitcast_convert_type(o, jnp.int16)
+            return jnp.concatenate([d, s2, o2], axis=-1)
+
+        def _local_quantized_packed(keys, dms, norms, profiles, freqs,
+                                    chan_ids):
+            d, s, o, m = _local_quantized(keys, dms, norms, profiles, freqs,
+                                          chan_ids)
+            return _pack_triple(d, s, o), m
+
+        def _local_quantized_packed_be(keys, dms, norms, profiles, freqs,
+                                       chan_ids):
+            d, s, o, m = _local_quantized(keys, dms, norms, profiles, freqs,
+                                          chan_ids)
+            return _pack_triple(swap16(d), s, o), m
+
+        _packed_specs = dict(
+            mesh=mesh,
+            in_specs=_quant_specs["in_specs"],
+            out_specs=(
+                P(OBS_AXIS, None, CHAN_AXIS, None),
+                P(OBS_AXIS, CHAN_AXIS),
+            ),
+        )
+        self._run_sharded_quantized_packed = jax.jit(
+            shard_map(_local_quantized_packed, **_packed_specs)
+        )
+        self._run_sharded_quantized_packed_be = jax.jit(
+            shard_map(_local_quantized_packed_be, **_packed_specs)
         )
 
     @staticmethod
@@ -317,7 +383,7 @@ class FoldEnsemble:
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
                     noise_norms=None, quantized=False, progress=None,
                     skip_chunk=None, prefetch=1, byte_order="little",
-                    finite_mask=False):
+                    finite_mask=False, fetch_ahead=0, timers=None):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -363,7 +429,32 @@ class FoldEnsemble:
         :meth:`run_quantized`).  The supervised exporter quarantines
         non-finite observations off this mask instead of re-scanning the
         payload on host.
+
+        ``fetch_ahead``: with ``fetch_ahead >= 1``, device->host transfers
+        move to a dedicated fetch thread feeding a bounded queue of (at
+        most) ``fetch_ahead`` fetched chunks — the link stays busy while
+        the consumer encodes/writes the previous chunk, on top of the
+        compute overlap ``prefetch`` already provides.  Backpressure is
+        two bounded queues: the consumer stalls dispatch when the device
+        window (``prefetch``) is full, and the fetch thread stalls when
+        the consumer falls ``fetch_ahead`` chunks behind — host memory is
+        bounded by ``fetch_ahead + 2`` chunks.  Ordering is unchanged
+        (one fetch thread, FIFO).  ``fetch_ahead=0`` (default) fetches
+        inline, exactly the pre-pipeline behavior.
+
+        ``timers``: optional
+        :class:`~psrsigsim_tpu.runtime.telemetry.StageTimers` — per-chunk
+        ``dispatch``/``fetch`` stage times, fetched bytes, and fetch-queue
+        depth samples accumulate there (the exporter adds encode/write).
+
+        Quantized chunks use fused transport internally: the device packs
+        data+scl+offs into one contiguous buffer per chunk (one transfer
+        instead of three; see ``_pack_triple``), and the host splits it
+        back before yielding — the yielded triple is bit-identical either
+        way.
         """
+        import time as _time
+
         if byte_order not in ("little", "big"):
             raise ValueError("byte_order must be 'little' or 'big'")
         if finite_mask and not quantized:
@@ -373,39 +464,57 @@ class FoldEnsemble:
             raise ValueError("chunk_size must be positive")
         if prefetch < 0:
             raise ValueError("prefetch must be >= 0")
+        if fetch_ahead < 0:
+            raise ValueError("fetch_ahead must be >= 0")
         if n_obs <= 0:
             return
         chunk_size = min(chunk_size, n_obs)
         n_obs_shards = self.mesh.shape[OBS_AXIS]
         chunk_size += (-chunk_size) % n_obs_shards
+        nbin = self.cfg.nph
 
         def _dispatch(start, count):
             """Launch one chunk asynchronously; returns device futures
             already trimmed to ``count`` observations."""
+            t0 = _time.perf_counter()
             idx = (start + np.arange(chunk_size)) % n_obs
             keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
                                                     noise_norms)
             if quantized:
-                prog = (self._run_sharded_quantized_be
+                prog = (self._run_sharded_quantized_packed_be
                         if byte_order == "big"
-                        else self._run_sharded_quantized)
-                d, s, o, m = prog(
+                        else self._run_sharded_quantized_packed)
+                packed, m = prog(
                     keys, dms_c, norms_c, self._profiles, self._freqs,
                     self._chan_ids,
                 )
-                if finite_mask:
-                    return (d[:count], s[:count], o[:count], m[:count])
-                return (d[:count], s[:count], o[:count])
-            out = self._run_sharded(
-                keys, dms_c, norms_c, self._profiles, self._freqs,
-                self._chan_ids,
-            )
-            return out[:count]
+                dev = ((packed[:count], m[:count]) if finite_mask
+                       else (packed[:count],))
+            else:
+                out = self._run_sharded(
+                    keys, dms_c, norms_c, self._profiles, self._freqs,
+                    self._chan_ids,
+                )
+                dev = out[:count]
+            if timers is not None:
+                timers.add("dispatch", _time.perf_counter() - t0)
+            return dev
 
         def _fetch(dev_block):
             # one batched device->host copy per chunk (device_get on the
-            # whole pytree), not one transfer per array
-            return jax.device_get(dev_block)
+            # whole pytree, and for quantized chunks ONE fused buffer plus
+            # the tiny finite mask), not one transfer per array
+            t0 = _time.perf_counter()
+            host = jax.device_get(dev_block)
+            if quantized:
+                d, s, o = _split_packed_chunk(host[0], nbin)
+                block = (d, s, o, host[1]) if finite_mask else (d, s, o)
+            else:
+                block = host
+            if timers is not None:
+                timers.add("fetch", _time.perf_counter() - t0,
+                           nbytes=_block_nbytes(host))
+            return block
 
         done_max = 0
 
@@ -417,22 +526,92 @@ class FoldEnsemble:
             if progress is not None:
                 progress(done_max, n_obs)
 
-        inflight = []  # [(start, count, device futures)]
-        for start in range(0, n_obs, chunk_size):
-            count = min(chunk_size, n_obs - start)
-            if skip_chunk is not None and skip_chunk(start, count):
-                _report(start + count)
-                continue
-            inflight.append((start, count, _dispatch(start, count)))
-            if len(inflight) > prefetch:
-                s0, _, dev = inflight.pop(0)
+        if fetch_ahead <= 0:
+            # inline-fetch path: dispatch-ahead overlap only (the
+            # pre-pipeline behavior, and the serial baseline the
+            # streaming tests compare bytes against)
+            inflight = []  # [(start, count, device futures)]
+            for start in range(0, n_obs, chunk_size):
+                count = min(chunk_size, n_obs - start)
+                if skip_chunk is not None and skip_chunk(start, count):
+                    _report(start + count)
+                    continue
+                inflight.append((start, count, _dispatch(start, count)))
+                if len(inflight) > prefetch:
+                    s0, _, dev = inflight.pop(0)
+                    block = _fetch(dev)
+                    _report(s0 + chunk_size)
+                    yield s0, block
+            for s0, _, dev in inflight:
                 block = _fetch(dev)
                 _report(s0 + chunk_size)
                 yield s0, block
-        for s0, _, dev in inflight:
-            block = _fetch(dev)
-            _report(s0 + chunk_size)
-            yield s0, block
+            return
+
+        # -- threaded fetch pipeline --------------------------------------
+        # main thread: dispatch (bounded by the device window) + yield;
+        # fetch thread: device_get + host split.  Queues are polled with
+        # short timeouts so generator teardown (consumer abandons us
+        # mid-stream) can always stop the thread without a sentinel
+        # squeezing into a full queue.
+        import queue as _queue
+        import threading as _threading
+        from collections import deque as _deque
+
+        in_q = _queue.Queue()                         # dispatched, unfetched
+        out_q = _queue.Queue(maxsize=max(1, fetch_ahead))  # fetched chunks
+        stop = _threading.Event()
+
+        def _fetcher():
+            while not stop.is_set():
+                try:
+                    item = in_q.get(timeout=0.05)
+                except _queue.Empty:
+                    continue
+                try:
+                    res = ("ok", item[0], _fetch(item[2]))
+                except BaseException as err:  # noqa: BLE001 — re-raised
+                    res = ("error", err, None)  # in the consumer thread
+                while not stop.is_set():
+                    try:
+                        out_q.put(res, timeout=0.05)
+                        break
+                    except _queue.Full:
+                        continue
+                if res[0] == "error":
+                    return
+
+        thread = _threading.Thread(target=_fetcher, daemon=True,
+                                   name="pss-chunk-fetch")
+        thread.start()
+        pending = _deque((start, min(chunk_size, n_obs - start))
+                         for start in range(0, n_obs, chunk_size))
+        dispatched = received = 0
+        window = max(1, prefetch)  # device-side in-flight beyond the fetch
+        try:
+            while pending or received < dispatched:
+                # keep the device window full without ever blocking on
+                # in_q (only this thread puts, so the size check is safe)
+                while pending and in_q.qsize() < window:
+                    s0, count = pending.popleft()
+                    if skip_chunk is not None and skip_chunk(s0, count):
+                        _report(s0 + count)
+                        continue
+                    in_q.put((s0, count, _dispatch(s0, count)))
+                    dispatched += 1
+                if received >= dispatched:
+                    continue  # everything so far was skipped
+                if timers is not None:
+                    timers.depth("fetch_queue", out_q.qsize())
+                kind, a, b = out_q.get()
+                if kind == "error":
+                    raise a
+                received += 1
+                _report(a + chunk_size)
+                yield a, b
+        finally:
+            stop.set()
+            thread.join(timeout=10.0)
 
     def signal_shell(self):
         """The configured signal object (metadata only — no ensemble data

@@ -10,16 +10,21 @@
 - :mod:`~psrsigsim_tpu.runtime.faults` — deterministic, explicitly-armed
   fault injection (named points, cross-process once-semantics) so all of
   the above is exercised by tests instead of by outages.
+- :mod:`~psrsigsim_tpu.runtime.telemetry` — per-stage timers for the
+  streaming export pipeline (dispatch/fetch/encode/write, queue depths,
+  bytes), accumulated into the export manifest and the bench report.
 """
 
 from .faults import FaultPlan
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
 from .supervisor import RunResult, RunSupervisor, supervised_export
+from .telemetry import StageTimers
 
 __all__ = [
     "FaultPlan",
     "RetryPolicy",
     "RetriesExhausted",
+    "StageTimers",
     "call_with_retry",
     "RunResult",
     "RunSupervisor",

@@ -73,10 +73,14 @@ class RunResult:
         True when the writer pool fell back to the serial writer.
     hashes : dict[str, str]
         basename -> sha256 for every committed file.
+    pipeline : dict or None
+        The export's stage-telemetry snapshot (the manifest's
+        ``pipeline`` key): per-stage busy seconds, fetched bytes, queue
+        depths, and the named bottleneck stage.
     """
 
     def __init__(self, paths, quarantined, retried, recovered, degraded,
-                 hashes, out_dir):
+                 hashes, out_dir, pipeline=None):
         self.paths = list(paths)
         self.quarantined = sorted(quarantined)
         self.retried = sorted(retried)
@@ -84,6 +88,7 @@ class RunResult:
         self.degraded = bool(degraded)
         self.hashes = dict(hashes)
         self.out_dir = out_dir
+        self.pipeline = pipeline
 
     def __repr__(self):
         return (f"RunResult(files={len(self.paths)}, "
@@ -333,7 +338,7 @@ class RunSupervisor:
         self.close()
         return RunResult(paths, self._still_bad, self._retried,
                          self._recovered, self._degraded, self._hashes,
-                         self.out_dir)
+                         self.out_dir, pipeline=man.get("pipeline"))
 
 
 def supervised_export(ens, n_obs, out_dir, template, pulsar, *,

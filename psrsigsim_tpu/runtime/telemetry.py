@@ -1,0 +1,82 @@
+"""Per-stage telemetry for the streaming export pipeline.
+
+The bulk-export path is a four-stage pipeline — **dispatch** (host-side
+program launch + input staging), **fetch** (device->host transfer, on a
+dedicated thread), **encode** (host byte assembly: packer slices, SUBINT
+record refills, shared-memory copies) and **write** (writev/rename, or
+the parent's wait on the writer pool) — with bounded queues between the
+stages.  When throughput disappoints, the question is always "which
+stage is the bottleneck on THIS host?", and the answer used to require
+reverse-engineering bench JSON (BENCH_r03-r05 each did it by hand).
+
+:class:`StageTimers` is the shared accumulator every stage reports into:
+monotonic per-stage busy time, call counts, fetched bytes, and bounded-
+queue depth samples.  The exporter folds a snapshot into the export
+manifest (``pipeline`` key) and ``bench.py``'s ``export_e2e`` section
+surfaces it, so every run names its own bottleneck.
+
+Thread-safety: ``add``/``depth`` are called from the fetch thread and
+the main thread concurrently; all mutation is under one lock.  The
+object is deliberately NOT picklable state for spawn workers — worker-
+side costs surface as the parent's ``write`` wait, which is the number
+the pipeline actually pays.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["StageTimers", "STAGES"]
+
+STAGES = ("dispatch", "fetch", "encode", "write")
+
+
+class StageTimers:
+    """Monotonic per-stage busy-time accumulator for one export run."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._seconds = {k: 0.0 for k in STAGES}
+        self._calls = {k: 0 for k in STAGES}
+        self._bytes_fetched = 0
+        self._depths = {}  # queue name -> [sum, samples, max]
+
+    def add(self, stage, seconds, nbytes=0):
+        """Accumulate ``seconds`` of busy time against ``stage`` (one of
+        :data:`STAGES`); ``nbytes`` counts device->host payload bytes
+        (fetch stage only, by convention)."""
+        with self._lock:
+            self._seconds[stage] += float(seconds)
+            self._calls[stage] += 1
+            self._bytes_fetched += int(nbytes)
+
+    def depth(self, name, value):
+        """Record one bounded-queue depth sample (e.g. the fetched-chunk
+        queue right before the consumer pops it: 0 means the consumer
+        starved, full means the consumer is the bottleneck)."""
+        with self._lock:
+            rec = self._depths.setdefault(name, [0, 0, 0])
+            rec[0] += int(value)
+            rec[1] += 1
+            rec[2] = max(rec[2], int(value))
+
+    def snapshot(self):
+        """One JSON-ready dict: per-stage seconds/counts, fetched bytes,
+        queue-depth stats, wall time, and the named bottleneck stage (the
+        stage with the most accumulated busy time — in an ideally
+        overlapped pipeline its time approaches the wall time and every
+        other stage hides under it)."""
+        with self._lock:
+            out = {}
+            for k in STAGES:
+                out[f"{k}_s"] = round(self._seconds[k], 6)
+                out[f"{k}_calls"] = self._calls[k]
+            out["bytes_fetched"] = self._bytes_fetched
+            out["wall_s"] = round(time.perf_counter() - self._t0, 6)
+            for name, (tot, n, mx) in sorted(self._depths.items()):
+                out[f"{name}_depth_max"] = mx
+                out[f"{name}_depth_mean"] = round(tot / max(n, 1), 3)
+            out["bottleneck"] = max(STAGES, key=lambda k: self._seconds[k])
+            return out

@@ -59,6 +59,7 @@ def main(argv=None):
     ap.add_argument("--chunk-size", type=int, default=2)
     ap.add_argument("--writers", type=int, default=1)
     ap.add_argument("--obs-per-file", type=int, default=1)
+    ap.add_argument("--pipeline-depth", type=int, default=2)
     args = ap.parse_args(argv)
 
     import jax
@@ -81,6 +82,7 @@ def main(argv=None):
         ens, args.n_obs, args.out_dir, TEMPLATE, ens.pulsar, seed=SEED,
         chunk_size=args.chunk_size, writers=args.writers,
         obs_per_file=args.obs_per_file, faults=plan,
+        pipeline_depth=args.pipeline_depth,
         resume="verify" if args.resume_mode == "verify" else True)
     print(json.dumps({
         "paths": res.paths, "quarantined": res.quarantined,

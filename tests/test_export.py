@@ -250,6 +250,30 @@ class TestWriterPoolAndManifest:
             da, db = open(fa, "rb").read(), open(fb, "rb").read()
             assert da == db, os.path.basename(fa)
 
+    def test_native_probe_state_seeding_semantics(self, monkeypatch):
+        """Satellite: spawn writer workers inherit the parent's MEASURED
+        native-encode verdicts through the pickled writer state
+        (io/export._writer_init -> native.seed_probe_state) — local
+        measurements win over seeded ones, and unset-only adoption means
+        a worker that probed keeps its own answer."""
+        from psrsigsim_tpu.io import native
+
+        monkeypatch.setattr(native, "_cast_ok", None)
+        monkeypatch.setattr(native, "_speed_ok", {})
+        st = {"cast_ok": True, "speed_ok": {"25": True, 21: False}}
+        native.seed_probe_state(st)
+        assert native._cast_ok is True
+        assert native._speed_ok == {25: True, 21: False}
+        # a second seed must not overwrite established verdicts
+        native.seed_probe_state({"cast_ok": False, "speed_ok": {25: False}})
+        assert native._cast_ok is True
+        assert native._speed_ok[25] is True
+        # empty/None states are no-ops
+        native.seed_probe_state(None)
+        native.seed_probe_state({})
+        assert native.probe_state() == {
+            "cast_ok": True, "speed_ok": {25: True, 21: False}}
+
     def test_manifest_blocks_mismatched_resume(self, ens, tmp_path):
         from psrsigsim_tpu.io.export import ExportManifestError
 
@@ -369,6 +393,126 @@ class TestGroupPackerSkip:
             for a, b in zip(plain[g], skipped[g]):
                 np.testing.assert_array_equal(a, b)
         assert packer._buf == {}
+
+
+class TestStreamingPipeline:
+    """Tentpole: the overlapped dispatch/fetch/encode/write export
+    pipeline must be byte-identical to the strictly serial path at every
+    (depth, chunk_size) combination, preserve ordering/skip semantics,
+    propagate fetch-thread errors, and leave its stage telemetry in the
+    export manifest."""
+
+    @staticmethod
+    def _shas(paths):
+        import hashlib
+
+        return {os.path.basename(p):
+                hashlib.sha256(open(p, "rb").read()).hexdigest()
+                for p in paths}
+
+    def test_depths_and_chunk_sizes_byte_identical(self, ens, tmp_path):
+        serial = export_ensemble_psrfits(
+            ens, 7, str(tmp_path / "serial"), TEMPLATE, ens.pulsar,
+            seed=21, chunk_size=3, pipeline_depth=0, writers=1)
+        want = self._shas(serial)
+        for depth, cs in ((1, 3), (2, 3), (3, 2), (2, 5)):
+            got = export_ensemble_psrfits(
+                ens, 7, str(tmp_path / f"p{depth}_{cs}"), TEMPLATE,
+                ens.pulsar, seed=21, chunk_size=cs, pipeline_depth=depth,
+                writers=1)
+            assert self._shas(got) == want, (depth, cs)
+
+    def test_packed_pipeline_byte_identical(self, ens, tmp_path):
+        serial = export_ensemble_psrfits(
+            ens, 7, str(tmp_path / "ser"), TEMPLATE, ens.pulsar, seed=22,
+            chunk_size=3, obs_per_file=2, pipeline_depth=0, writers=1)
+        piped = export_ensemble_psrfits(
+            ens, 7, str(tmp_path / "pip"), TEMPLATE, ens.pulsar, seed=22,
+            chunk_size=3, obs_per_file=2, pipeline_depth=3, writers=1)
+        assert self._shas(piped) == self._shas(serial)
+
+    def test_manifest_records_stage_telemetry(self, ens, tmp_path):
+        import json
+
+        from psrsigsim_tpu.runtime import StageTimers
+
+        tel = StageTimers()
+        out = str(tmp_path / "tel")
+        export_ensemble_psrfits(ens, 5, out, TEMPLATE, ens.pulsar, seed=23,
+                                chunk_size=3, pipeline_depth=2, writers=1,
+                                telemetry=tel)
+        man = json.load(open(os.path.join(out, "export_manifest.json")))
+        pipe = man["pipeline"]
+        assert pipe["depth"] == 2
+        for stage in ("dispatch", "fetch", "encode", "write"):
+            assert f"{stage}_s" in pipe and pipe[f"{stage}_calls"] > 0, stage
+        assert pipe["bytes_fetched"] > 0
+        assert pipe["bottleneck"] in ("dispatch", "fetch", "encode",
+                                      "write")
+        # the caller-passed object accumulated the same run
+        snap = tel.snapshot()
+        assert snap["bytes_fetched"] == pipe["bytes_fetched"]
+
+    def test_noop_resume_preserves_pipeline_telemetry(self, ens, tmp_path):
+        """A fully-resumed run that dispatches nothing must not replace
+        the manifest's pipeline record with an all-zero snapshot."""
+        import json
+
+        out = str(tmp_path / "noop")
+        export_ensemble_psrfits(ens, 4, out, TEMPLATE, ens.pulsar, seed=26,
+                                chunk_size=4, pipeline_depth=2, writers=1)
+        man_path = os.path.join(out, "export_manifest.json")
+        before = json.load(open(man_path))["pipeline"]
+        assert before["write_calls"] > 0
+        export_ensemble_psrfits(ens, 4, out, TEMPLATE, ens.pulsar, seed=26,
+                                chunk_size=4, pipeline_depth=2, writers=1)
+        assert json.load(open(man_path))["pipeline"] == before
+
+    def test_iter_chunks_fetch_ahead_bit_identical_and_ordered(self, ens):
+        # threaded fetch must not change bytes, ordering, chunk
+        # boundaries, skip behavior, or progress monotonicity
+        n = 10
+        runs = {}
+        for fa in (0, 1, 3):
+            calls = []
+            runs[fa] = (list(ens.iter_chunks(
+                n, chunk_size=3, seed=24, quantized=True, fetch_ahead=fa,
+                skip_chunk=lambda s, c: s == 3,
+                progress=lambda d, t: calls.append(d))), calls)
+        blocks0, calls0 = runs[0]
+        assert calls0 == sorted(calls0)
+        for fa in (1, 3):
+            blocks, calls = runs[fa]
+            assert [s for s, _ in blocks] == [s for s, _ in blocks0]
+            assert 3 not in [s for s, _ in blocks]
+            assert calls == sorted(calls)
+            for (_, a), (_, b) in zip(blocks0, blocks):
+                for xa, xb in zip(a, b):
+                    assert np.array_equal(np.asarray(xa), np.asarray(xb))
+
+    def test_fetch_thread_error_propagates(self, ens, monkeypatch):
+        import jax
+
+        real_get = jax.device_get
+
+        def boom(x):
+            raise RuntimeError("injected fetch failure")
+
+        it = ens.iter_chunks(6, chunk_size=3, seed=25, quantized=True,
+                             fetch_ahead=2)
+        monkeypatch.setattr(jax, "device_get", boom)
+        try:
+            with pytest.raises(RuntimeError, match="injected fetch"):
+                list(it)
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_get)
+
+    def test_invalid_depth_args(self, ens, tmp_path):
+        with pytest.raises(ValueError, match="fetch_ahead"):
+            list(ens.iter_chunks(4, fetch_ahead=-1))
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            export_ensemble_psrfits(ens, 2, str(tmp_path / "x"), TEMPLATE,
+                                    ens.pulsar, pipeline_depth=-1)
 
 
 class TestExportEphemerisReapply:

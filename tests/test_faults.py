@@ -33,9 +33,10 @@ N_OBS, CHUNK = 12, 8
 
 
 def _run_export(out_dir, plan_file=None, resume_mode="resume",
-                expect_kill=False):
+                expect_kill=False, extra=()):
     cmd = [sys.executable, RUNNER, out_dir, "--n-obs", str(N_OBS),
            "--chunk-size", str(CHUNK), "--resume-mode", resume_mode]
+    cmd += list(extra)
     if plan_file:
         cmd += ["--plan", plan_file]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=540)
@@ -83,6 +84,29 @@ class TestKillResume:
         survivors = _fits(out)
         assert 0 < len(survivors) < N_OBS     # genuinely mid-run
         _run_export(out, plan_file=plan_file)  # plan exhausted: no re-kill
+        got = _fits(out)
+        ref = _fits(clean_dir)
+        assert [os.path.basename(p) for p in got] == \
+               [os.path.basename(p) for p in ref]
+        for a, b in zip(ref, got):
+            assert open(a, "rb").read() == open(b, "rb").read(), b
+
+    def test_mid_pipeline_kill_then_verify_resume(self, clean_dir,
+                                                  tmp_path):
+        """run.kill fires after chunk 0's commit while the streaming
+        pipeline (depth 3) has later chunks in flight — dispatched on
+        device, mid-fetch, or queued for the writers.  Every in-flight
+        byte dies with the process; the journal/cursor record only the
+        committed prefix, and a verify-resume completes to output
+        bit-identical to the uninterrupted export."""
+        out = str(tmp_path / "pkilled")
+        plan_file = _write_plan(tmp_path, "pkill",
+                                {"run.kill": {"after_start": 0}})
+        depth = ["--pipeline-depth", "3"]
+        _run_export(out, plan_file=plan_file, expect_kill=True, extra=depth)
+        survivors = _fits(out)
+        assert 0 < len(survivors) < N_OBS
+        _run_export(out, resume_mode="verify", extra=depth)
         got = _fits(out)
         ref = _fits(clean_dir)
         assert [os.path.basename(p) for p in got] == \
