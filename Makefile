@@ -5,8 +5,12 @@
 # `make bench-export` is the quick streaming-export gate: pipelined vs
 # serial byte identity, pipeline >= serial throughput, stage timers
 # present, compute slope resolvable (bench.py export_smoke).
+# `make bench-mc` is the Monte-Carlo study-engine gate: bit-identical
+# merged statistics + artifact fingerprints at trial-chunk sizes
+# {32,128,512}, interrupted-sweep resume identity, stage timers present
+# (bench.py mc_smoke).
 
-.PHONY: lint test test-faults bench-export
+.PHONY: lint test test-faults bench-export bench-mc
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -19,3 +23,6 @@ test-faults:
 
 bench-export:
 	JAX_PLATFORMS=cpu PSS_BENCH_EXPORT_OBS=48 python bench.py --export-smoke
+
+bench-mc:
+	JAX_PLATFORMS=cpu python bench.py --mc-smoke

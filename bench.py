@@ -1104,6 +1104,221 @@ def export_smoke(n_obs=None):
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 6: Monte-Carlo study engine (psrsigsim_tpu/mc)
+# ---------------------------------------------------------------------------
+
+
+def _numpy_fftfit(prof, tmpl, upsample=16, newton=6):
+    """Serial NumPy FFTFIT (Taylor 1992): the same bracket-then-Newton
+    estimator as ops/toa.py, written as the host loop a reference-style
+    study would run per channel (the config6 CPU baseline's TOA step)."""
+    n = len(prof)
+    P = np.fft.rfft(prof)[1:]
+    T = np.fft.rfft(tmpl)[1:]
+    amp = np.abs(P) * np.abs(T)
+    phase = np.angle(P) - np.angle(T)
+    full = np.zeros(upsample * n // 2 + 1, complex)
+    full[1: n // 2 + 1] = amp * np.exp(1j * phase)
+    corr = np.fft.irfft(full, upsample * n)
+    tau = np.argmax(corr) / (upsample * n)
+    w = 2 * np.pi * np.arange(1, n // 2 + 1)
+    for _ in range(newton):
+        ph = phase + w * tau
+        d1 = -np.sum(amp * w * np.sin(ph))
+        d2 = -np.sum(amp * w * w * np.cos(ph))
+        delta = d1 / d2 if d2 < 0 else 0.0
+        tau -= float(np.clip(delta, -0.5 / n, 0.5 / n))
+    return (tau + 0.5) % 1.0 - 0.5
+
+
+def cpu_reference_mc_trial(profiles, cfg, freqs, noise_norm, rng):
+    """One Monte-Carlo study trial the reference's way: host-side prior
+    sampling, the serial per-channel observation
+    (:func:`cpu_reference_obs`), a host fold, and a serial per-channel
+    NumPy FFTFIT — what a study loop over the reference package would
+    actually execute per trial."""
+    dm = rng.uniform(10.0, 20.0)
+    nscale = np.exp(rng.uniform(np.log(0.5), np.log(2.0)))
+    d = cpu_reference_obs(profiles, cfg, freqs, dm, noise_norm * nscale, rng)
+    folded = d.reshape(d.shape[0], cfg.nsub, cfg.nph).sum(axis=1)
+    shifts = [_numpy_fftfit(folded[c], profiles[c])
+              for c in range(folded.shape[0])]
+    return float(np.mean(shifts))
+
+
+def build_mc_study(nchan=64, n_dev=None):
+    """The config6 workload: the export-bench fold geometry under a
+    dm x noise_scale prior space (the BASELINE 'Monte-Carlo TOA-error
+    ensemble' as an actual study declaration)."""
+    from psrsigsim_tpu.mc import LogUniform, MonteCarloStudy, Uniform
+    from psrsigsim_tpu.parallel import make_mesh
+
+    sim, cfg, profiles, noise_norm, freqs = build_workload(
+        nchan=nchan, period_s=0.005, samprate_mhz=0.1024, sublen_s=2.0,
+        tobs_s=16.0, fcent=1380.0, bw=400.0, smean=0.009, dm=15.9,
+    )
+    if n_dev is None:
+        n_dev = len(jax.devices())
+    study = MonteCarloStudy.from_simulation(
+        sim, {"dm": Uniform(10.0, 20.0), "noise_scale": LogUniform(0.5, 2.0)},
+        seed=1, mesh=make_mesh((n_dev, 1)))
+    return study, cfg, np.asarray(profiles, np.float64), noise_norm, freqs
+
+
+def time_mc_study(n_trials=None, chunk=256):
+    """Config 6: Monte-Carlo study throughput — trials/sec of the full
+    in-graph trial program (prior sampling -> synth -> ISM -> noise ->
+    fold -> FFTFIT -> reduction) vs the NumPy reference loop, plus the
+    stage timers of a real chunked sweep.
+
+    Device timing is the standard K-slope (K back-to-back chunks inside
+    one fori_loop, full-array accumulator against DCE, fixed dispatch
+    cost cancelled — :func:`_timed_slope`)."""
+    from psrsigsim_tpu.runtime import StageTimers
+    from psrsigsim_tpu.utils.rng import stage_key as _stage_key
+
+    if n_trials is None:
+        n_trials = int(os.environ.get("PSS_BENCH_MC_TRIALS", "512"))
+    study, cfg, prof64, noise_norm, freqs = build_mc_study()
+    from psrsigsim_tpu.parallel.mesh import OBS_AXIS as _OBS
+
+    width = chunk + (-chunk) % study.mesh.shape[_OBS]
+    prog = study._program(width)
+    M = len(study.metric_names)
+    idxs = jnp.arange(width, dtype=jnp.int32)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def run_k(root, k):
+        def body(i, acc):
+            r = jax.random.fold_in(root, i)
+            keys = jax.vmap(lambda j: _stage_key(r, "user", j))(idxs)
+            metrics, hist, mn, mx = prog(
+                keys, idxs, jnp.int32(width), study._profiles_dev,
+                study._freqs_dev, study._chan_ids_dev)
+            return acc + metrics
+        return jax.lax.fori_loop(0, k, body,
+                                 jnp.zeros((width, M), jnp.float32))
+
+    def call(k, seed):
+        return run_k(jax.random.key(seed), k)
+
+    slope, _, sdiag = _timed_slope(call, 2, 10)
+    t_trial = slope / width
+    sync = _sync_probe(lambda s: call(10, s))
+
+    # a real chunked sweep for the stage telemetry (and as an end-to-end
+    # sanity pass through the journal-less path)
+    tel = StageTimers(extra_stages=("reduce",))
+    study.run(n_trials, chunk_size=chunk, telemetry=tel)
+    snap = tel.snapshot()
+
+    rng = np.random.default_rng(0)
+    cpu_reference_mc_trial(prof64, cfg, freqs, noise_norm, rng)  # warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cpu_reference_mc_trial(prof64, cfg, freqs, noise_norm, rng)
+        times.append(time.perf_counter() - t0)
+    t_cpu = float(np.median(times))
+
+    return {
+        "n_trials": n_trials,
+        "chunk_size": chunk,
+        "nchan": cfg.meta.nchan,
+        "nsub": cfg.nsub,
+        "nbin": cfg.nph,
+        "priors": ["dm", "noise_scale"],
+        "metrics_per_trial": M,
+        "tpu_trials_per_sec": round(1.0 / t_trial, 2),
+        "cpu_s_per_trial": round(t_cpu, 6),
+        "speedup": round(t_cpu / t_trial, 2),
+        "slope_ok": sdiag["slope_ok"],
+        **_sync_fields(sync),
+        "stage_timers": snap,
+        "bottleneck_stage": snap["bottleneck"],
+    }
+
+
+def mc_smoke():
+    """Quick Monte-Carlo-engine gate (``make bench-mc``): a tiny study
+    must (a) produce bit-identical merged statistics and artifact
+    fingerprints at trial-chunk sizes {32, 128, 512} (the acceptance
+    invariance), (b) resume an interrupted sweep to a byte-identical
+    artifact, and (c) report all four pipeline stage timers.  Runs on
+    whatever platform jax has (CPU in CI); asserts invariants, not rates.
+    """
+    import shutil
+    import tempfile
+
+    from psrsigsim_tpu.mc import LogUniform, MonteCarloStudy, Uniform
+    from psrsigsim_tpu.parallel import make_mesh
+    from psrsigsim_tpu.runtime import StageTimers
+
+    n_trials = int(os.environ.get("PSS_BENCH_MC_TRIALS", "512"))
+    sim, cfg, profiles, noise_norm, freqs = build_workload(
+        nchan=4, period_s=0.005, samprate_mhz=0.1024, sublen_s=0.5,
+        tobs_s=1.0, fcent=1380.0, bw=400.0, smean=0.009, dm=15.9,
+    )
+    n_dev = len(jax.devices())
+    study = MonteCarloStudy.from_simulation(
+        sim, {"dm": Uniform(10.0, 20.0), "noise_scale": LogUniform(0.5, 2.0)},
+        seed=5, mesh=make_mesh((n_dev, 1)))
+
+    base = tempfile.mkdtemp(prefix="pss_mc_smoke_")
+    try:
+        fps, summaries, snap = [], [], None
+        for cs in (32, 128, 512):
+            tel = StageTimers(extra_stages=("reduce",))
+            res = study.run(n_trials, chunk_size=cs,
+                            out_dir=os.path.join(base, f"c{cs}"),
+                            telemetry=tel)
+            fps.append(res.fingerprint)
+            summaries.append(json.dumps(res.summary(), sort_keys=True))
+            snap = tel.snapshot()
+
+        # (a) chunk-size invariance: merged stats AND artifact bytes
+        assert summaries[0] == summaries[1] == summaries[2], (
+            "merged summary statistics differ across chunk sizes")
+        assert fps[0] == fps[1] == fps[2], (
+            f"artifact fingerprints differ across chunk sizes: {fps}")
+
+        # (b) interruption + resume -> byte-identical artifact.  The stop
+        # point is derived from the actual chunk count so a small
+        # PSS_BENCH_MC_TRIALS override still interrupts MID-sweep (a
+        # stop >= n_chunks would let the run complete and fail the
+        # "no result" assert with no real regression present)
+        rdir = os.path.join(base, "resume")
+        rchunk = 64
+        n_chunks = -(-n_trials // rchunk)
+        stop_after = max(1, n_chunks // 2)
+        if n_chunks >= 2:
+            stopped = study.run(n_trials, chunk_size=rchunk, out_dir=rdir,
+                                _stop_after_chunks=stop_after)
+            assert stopped is None, (
+                "interrupted run must not produce a result")
+        resumed = study.run(n_trials, chunk_size=rchunk, out_dir=rdir)
+        assert resumed.fingerprint == fps[0], (
+            "resumed artifact differs from an uninterrupted run")
+
+        # (c) stage timers all present and live
+        for stage in ("dispatch", "fetch", "reduce", "write"):
+            assert snap[f"{stage}_calls"] > 0, f"stage {stage} never reported"
+        assert snap["bytes_fetched"] > 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    return {
+        "metric": "mc_smoke",
+        "n_trials": n_trials,
+        "chunk_sizes": [32, 128, 512],
+        "fingerprint": fps[0],
+        "stage_timers": snap,
+        "bottleneck_stage": snap["bottleneck"],
+        "ok": True,
+    }
+
+
 def time_io_encode(nchan=2048, nsub=20, nbin=2048):
     """Host-side PSRFITS subint encode (float32 -> '>i2' relayout) and pdv
     text formatting: C++ fast path vs the pure-Python fallback."""
@@ -1141,12 +1356,19 @@ def time_io_encode(nchan=2048, nsub=20, nbin=2048):
         "".join("%s %s %s %s \n" % (0, 0, bb, row[bb]) for bb in range(nbin))
     t_pdv_py = (time.perf_counter() - t0) / 4
 
+    # regression gate (satellite of the MC-engine PR): a native encode the
+    # bench itself just measured >2x faster MUST be what exports select —
+    # BENCH_r05 shipped a 4.17x win unselected; raising here turns any
+    # repeat of that probe/reality split into a bench failure
+    selected = bool(native.encode_preferred(data.size))
+    gate_ok = native.encode_gate_check(t_py / t_nat, selected)
+
     return {
         "native_available": True,
         # what exports actually use: the measured per-size speed probe
         # must agree, or the native path is auto-disabled (io/native)
-        "native_encode_selected": bool(
-            native.encode_preferred(data.size)),
+        "native_encode_selected": selected,
+        "encode_gate_ok": gate_ok,
         "subint_encode_native_s": round(t_nat, 5),
         "subint_encode_python_s": round(t_py, 5),
         "subint_encode_speedup": round(t_py / t_nat, 2),
@@ -1186,6 +1408,12 @@ def main():
         # `make bench-export`: the quick pipelined-vs-serial export gate
         with contextlib.redirect_stdout(sys.stderr):
             result = export_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
+    if "--mc-smoke" in sys.argv[1:]:
+        # `make bench-mc`: chunk invariance + resume identity + timers
+        with contextlib.redirect_stdout(sys.stderr):
+            result = mc_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
     with contextlib.redirect_stdout(sys.stderr):
@@ -1322,6 +1550,14 @@ def _main():
     detail["config5_multipulsar"] = mp
     log(f"config5_multipulsar: device {mp['tpu_obs_per_sec']:.1f} obs/s vs "
         f"cpu {1/mp['cpu_s_per_obs']:.2f} obs/s -> {mp['speedup']:.1f}x")
+    _checkpoint(detail)
+
+    # --- config 6: Monte-Carlo study engine -----------------------------
+    mc = time_mc_study()
+    detail["config6_mc"] = mc
+    log(f"config6_mc: device {mc['tpu_trials_per_sec']:.1f} trials/s vs "
+        f"cpu {1/mc['cpu_s_per_trial']:.2f} trials/s -> "
+        f"{mc['speedup']:.1f}x (bottleneck: {mc['bottleneck_stage']})")
     _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------

@@ -20,7 +20,7 @@ def __getattr__(name):
     import importlib
 
     if name in ("signal", "pulsar", "models", "ops", "ism", "telescope",
-                "simulate", "io", "parallel", "data", "runtime"):
+                "simulate", "io", "parallel", "data", "runtime", "mc"):
         try:
             return importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as err:

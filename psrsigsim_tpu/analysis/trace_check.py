@@ -80,6 +80,11 @@ def _specs():
         "normal_sample": (lambda k: ops.normal_sample(k, (32,)), (key,)),
         "fftfit_shift": (ops.fftfit_shift, (prof, prof)),
         "fftfit_batch": (ops.fftfit_batch, (jnp.stack([prof, prof]), prof)),
+        "fftfit_combine": (ops.fftfit_combine,
+                           (jnp.asarray([0.1, -0.05, 0.02], f),
+                            jnp.asarray([0.01, 0.02, 0.01], f))),
+        "fixed_histogram": (lambda x: ops.fixed_histogram(x, -1.0, 1.0, 8),
+                            (block[0],)),
         "block_downsample": (lambda d: ops.block_downsample(d, 4), (block,)),
         "rebin": (lambda d: ops.rebin(d, 16), (block,)),
         "clip_cast": (lambda b: ops.clip_cast(b, 200.0), (block,)),

@@ -949,7 +949,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                             parfile=None, MJD_start=56000.0,
                             ref_MJD=56000.0, writers=None,
                             obs_per_file=1, supervisor=None, faults=None,
-                            pipeline_depth=2, telemetry=None):
+                            pipeline_depth=2, telemetry=None,
+                            manifest_extra=None):
     """Export ``n_obs`` ensemble observations as PSRFITS files.
 
     Args:
@@ -1017,6 +1018,11 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             (dispatch/fetch/encode/write), fetched bytes and queue depths
             are accumulated there and folded into the export manifest
             under ``"pipeline"``.
+        manifest_extra: optional dict of extra NON-fingerprint keys
+            merged into the export manifest (provenance stamps — the
+            Monte-Carlo study engine records which study generated a
+            dataset here).  Keys never participate in resume matching
+            and may not collide with fingerprint fields.
 
     Returns:
         list of the output file paths (length ``ceil(n_obs/obs_per_file)``).
@@ -1053,9 +1059,19 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         parfile = os.path.join(out_dir, f"{pulsar.name}_sim.par")
         make_par(sig, pulsar, outpar=parfile)
 
-    _check_manifest(out_dir, _manifest_fingerprint(
+    fp = _manifest_fingerprint(
         n_obs, seed, dms, noise_norms, tmpl, parfile, MJD_start, ref_MJD,
-        obs_per_file), resume)
+        obs_per_file)
+    _check_manifest(out_dir, fp, resume)
+    if manifest_extra:
+        clash = set(manifest_extra) & set(fp)
+        if clash:
+            raise ValueError(
+                f"manifest_extra keys {sorted(clash)} collide with "
+                "fingerprint fields")
+        man = _load_manifest(out_dir) or dict(fp)
+        man.update(manifest_extra)
+        _write_manifest(out_dir, man)
 
     if writers is None:
         writers = min(8, os.cpu_count() or 1)

@@ -25,9 +25,10 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "encode_available", "encode_preferred",
-           "encode_speed_probe", "encode_subints", "format_pdv_block",
-           "median3", "probe_state", "seed_probe_state"]
+__all__ = ["available", "encode_available", "encode_gate_check",
+           "encode_preferred", "encode_speed_probe", "encode_subints",
+           "format_pdv_block", "median3", "probe_state",
+           "seed_probe_state"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "encode.cpp")
@@ -221,6 +222,33 @@ def encode_preferred(n_samples=None):
             # simpler numpy path
             _speed_ok[bucket] = bool(t_nat < 0.9 * t_np)
     return _speed_ok[bucket]
+
+
+def encode_gate_check(measured_speedup, selected, threshold=2.0):
+    """Bench regression gate: a clearly-winning native encode MUST be
+    selected.
+
+    BENCH_r05 measured the compiled encoder 4.17x faster than the real
+    Python fallback while :func:`encode_preferred` still said "numpy
+    wins" (its probe raced an idealized baseline nobody runs) — so every
+    export silently took the slow path.  The probe was fixed in the
+    following round; this gate pins the fix: whenever the bench's
+    independently measured speedup exceeds ``threshold`` (default 2x —
+    far beyond the probe's own 0.9 photo-finish margin, so a borderline
+    host can never flap it) and the probe still left native unselected,
+    raise instead of publishing the contradiction as a flag in JSON.
+
+    Returns True when consistent (``bench.py time_io_encode`` records it
+    as ``encode_gate_ok``); raises RuntimeError on the regression.
+    """
+    if float(measured_speedup) > float(threshold) and not selected:
+        raise RuntimeError(
+            f"native-encode selection regressed: measured speedup "
+            f"{float(measured_speedup):.2f}x exceeds {float(threshold):.1f}x "
+            "but encode_preferred() did not select the native path — the "
+            "speed probe's baseline has drifted from the real fallback "
+            "again (see BENCH_r05 io_encode and io/native encode_preferred)")
+    return True
 
 
 def encode_speed_probe():

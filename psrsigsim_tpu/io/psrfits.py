@@ -429,8 +429,16 @@ class PSRFITS(BaseFile):
         the simulated values.  ``eq_wts=True`` (scl=1/offs=0) and
         ``quantized`` files round-trip exactly.
         """
+        import warnings
+
         loader = PSRFITS(path=self.path, template=self.path)
-        S = loader.make_signal_from_psrfits()
+        with warnings.catch_warnings():
+            # the SEARCH fold-shell caveat is for DIRECT callers; this IS
+            # the documented override path (fold/nsamp are set below)
+            warnings.filterwarnings(
+                "ignore", message=".*SEARCH-mode template.*",
+                category=UserWarning)
+            S = loader.make_signal_from_psrfits()
 
         f = loader.fits_template
         sub = f["SUBINT"]
@@ -474,11 +482,69 @@ class PSRFITS(BaseFile):
         return S
 
     # -- template -> signal -------------------------------------------------
+    def _validate_template_geometry(self):
+        """Loud malformed-template guard for the template -> signal path.
+
+        Collects every geometry defect at once (NCHAN/NBIN/TBIN/TSUBINT
+        missing, zero, or negative) and raises one ValueError naming them
+        all, so a corrupt or hand-edited template fails at load with an
+        actionable message instead of silently producing a signal shell
+        whose sample rate or fold geometry is garbage.  Unknown OBS_MODE
+        values raise NotImplementedError — there is no defined shell for
+        them (e.g. CAL files).
+        """
+        if self.obs_mode not in ("PSR", "SEARCH"):
+            raise NotImplementedError(
+                f"make_signal_from_psrfits supports OBS_MODE 'PSR' and "
+                f"'SEARCH'; template declares {self.obs_mode!r}")
+
+        def _num(v):
+            try:
+                return float(getattr(v, "value", v))
+            except (TypeError, ValueError):
+                return None
+
+        problems = []
+        nchan = _num(self.nchan)
+        if nchan is None or not nchan >= 1 or not nchan.is_integer():
+            problems.append(f"NCHAN={self.nchan!r} (need an int >= 1)")
+        if self.obs_mode == "PSR":
+            nbin = _num(self.nbin)
+            if nbin is None or not nbin >= 1 or not nbin.is_integer():
+                problems.append(f"NBIN={self.nbin!r} (need an int >= 1 — "
+                                "the fold sample rate is F0 * NBIN)")
+        else:
+            tbin = _num(self.tbin)
+            if tbin is None or not tbin > 0:
+                problems.append(f"TBIN={self.tbin!r} (need > 0 s — the "
+                                "SEARCH sample rate is 1/TBIN)")
+        tsub = _num(self.tsubint)
+        if tsub is None or not tsub > 0:
+            problems.append(f"TSUBINT={self.tsubint!r} (need > 0 s — "
+                            "becomes the shell's sublen)")
+        if problems:
+            raise ValueError(
+                f"template {getattr(self, 'file_name', self.path)!r} has "
+                "malformed geometry; refusing to build a signal shell "
+                "from it: " + "; ".join(problems))
+
     def make_signal_from_psrfits(self):
         """Construct a metadata-only FilterBankSignal from the template
-        (reference: io/psrfits.py:439-483)."""
+        (reference: io/psrfits.py:439-483).
+
+        The reference's version carries a geometry TODO and would
+        propagate whatever the header claims; here a malformed template
+        fails LOUDLY (:meth:`_validate_template_geometry`) instead of
+        returning a signal shell with nonsense geometry that only breaks
+        much later (wrong sample rate, zero-bin folds).  SEARCH-mode
+        templates additionally warn: the reconstructed shell is built
+        with fold-mode geometry (``sublen = TSUBINT``) for reference
+        parity — :meth:`load` overrides ``fold``/``nsamp`` afterwards,
+        but a direct caller must not trust those two fields.
+        """
         self._fits_mode = "copy"
         self.get_signal_params()
+        self._validate_template_geometry()
 
         if self.obs_mode == "PSR":
             f0 = self.pfit_dict.get("F0")
@@ -488,6 +554,15 @@ class PSRFITS(BaseFile):
                 raise ValueError("No pulsar frequency defined in input fits file.")
             s_rate = f_use * self.nbin * 1e-6  # MHz
         else:
+            import warnings
+
+            warnings.warn(
+                "make_signal_from_psrfits on a SEARCH-mode template: the "
+                "reconstructed signal shell carries fold-mode geometry "
+                "(fold=True, sublen=TSUBINT) for reference parity; "
+                "PSRFITS.load() overrides fold/nsamp from the data — do "
+                "not trust those fields from a direct call.",
+                stacklevel=2)
             s_rate = (1 / self.tbin).to("MHz").value
 
         S = FilterBankSignal(

@@ -1,0 +1,34 @@
+"""Monte-Carlo study engine: declarative priors -> in-graph trials ->
+streaming TOA/statistics reduction -> resumable, fingerprinted results.
+
+The workload-level consumer of the sharded pipeline stack: declare what
+varies (:mod:`~psrsigsim_tpu.mc.priors`), and
+:class:`~psrsigsim_tpu.mc.MonteCarloStudy` compiles one jitted, sharded
+trial program per chunk — pulse synthesis, ISM delays, radiometer noise,
+on-device fold, FFTFIT TOA measurement — and reduces every chunk on
+device into streaming accumulators.  Sweeps journal per-chunk (PR-2
+discipline) so a SIGKILL'd 100k-trial run resumes bit-identically, and
+:class:`~psrsigsim_tpu.mc.StudyResult` owns the merged statistics and
+the fingerprinted artifact.  ``python -m psrsigsim_tpu.mc study.toml``
+runs a study from a declarative spec file.
+"""
+
+from .priors import (Choice, Fixed, Grid, LogUniform, Normal, Prior,
+                     Uniform, parse_prior)
+from .results import StudyResult
+from .study import KNOBS, MonteCarloStudy, StudyManifestError
+
+__all__ = [
+    "MonteCarloStudy",
+    "StudyResult",
+    "StudyManifestError",
+    "KNOBS",
+    "Prior",
+    "Fixed",
+    "Uniform",
+    "LogUniform",
+    "Normal",
+    "Grid",
+    "Choice",
+    "parse_prior",
+]

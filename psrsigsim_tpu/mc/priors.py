@@ -1,0 +1,255 @@
+"""Declarative parameter priors for Monte-Carlo studies, sampled in-graph.
+
+A study declares "what varies" as a dict of ``{knob_name: Prior}``; the
+study engine samples every trial's parameters INSIDE the jitted trial
+program from per-trial folded keys — ``fold_in(stage_key(trial_key,
+"prior"), slot)`` with the trial key derived from (study seed, GLOBAL
+trial index) exactly the way :class:`~psrsigsim_tpu.parallel.FoldEnsemble`
+derives observation keys.  Consequences, both load-bearing:
+
+* any single trial is reproducible in isolation (re-run trial ``i`` alone
+  and its parameters and data match the sweep's bit-for-bit), and
+* sampled parameters are independent of batch/chunk size and mesh shape,
+  which is what makes the engine's chunk-size-invariance and kill/resume
+  guarantees possible at all.
+
+Priors are frozen dataclasses with hashable fields, so they can ride in
+static jit configuration; ``sample(key, idx)`` returns a float32 scalar
+and must stay trace-safe (no Python branching on traced values).
+``describe()`` returns the canonical dict used for study fingerprints and
+the CLI's TOML/JSON specs (:func:`parse_prior` is its inverse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Prior", "Fixed", "Uniform", "LogUniform", "Normal", "Grid",
+           "Choice", "parse_prior"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Prior:
+    """Base class: a scalar per-trial parameter distribution."""
+
+    def sample(self, key, idx):
+        """Draw one float32 value for trial ``idx`` from ``key`` (a key
+        already folded per (trial, parameter slot) by the study engine;
+        ``idx`` is the traced GLOBAL trial index, used only by the
+        deterministic :class:`Grid`)."""
+        raise NotImplementedError
+
+    def support(self):
+        """Host-side ``(lo, hi)`` floats bounding (essentially) all mass —
+        sizes the study's fixed histogram bins and conditional-stat bins."""
+        raise NotImplementedError
+
+    def describe(self):
+        """Canonical JSON-able spec dict (study fingerprints, CLI)."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Fixed(Prior):
+    """Degenerate prior: every trial gets ``value`` (useful to pin a knob
+    while keeping it in the recorded per-trial parameter columns)."""
+
+    value: float
+
+    def sample(self, key, idx):
+        return jnp.float32(self.value)
+
+    def support(self):
+        v = float(self.value)
+        pad = max(abs(v) * 0.5, 0.5)
+        return v - pad, v + pad
+
+    def describe(self):
+        return {"dist": "fixed", "value": float(self.value)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Uniform(Prior):
+    """Uniform on ``[lo, hi)``."""
+
+    lo: float
+    hi: float
+
+    def __post_init__(self):
+        if not float(self.hi) > float(self.lo):
+            raise ValueError(f"Uniform needs hi > lo, got [{self.lo}, {self.hi})")
+
+    def sample(self, key, idx):
+        u = jax.random.uniform(key, (), jnp.float32)
+        return jnp.float32(self.lo) + (jnp.float32(self.hi)
+                                       - jnp.float32(self.lo)) * u
+
+    def support(self):
+        return float(self.lo), float(self.hi)
+
+    def describe(self):
+        return {"dist": "uniform", "lo": float(self.lo), "hi": float(self.hi)}
+
+
+@dataclasses.dataclass(frozen=True)
+class LogUniform(Prior):
+    """Log-uniform on ``[lo, hi)`` (both positive) — the natural prior for
+    scale knobs (scattering tau, S/N, T_sys factors)."""
+
+    lo: float
+    hi: float
+
+    def __post_init__(self):
+        if not 0.0 < float(self.lo) < float(self.hi):
+            raise ValueError(
+                f"LogUniform needs 0 < lo < hi, got [{self.lo}, {self.hi})")
+
+    def sample(self, key, idx):
+        import math
+
+        u = jax.random.uniform(key, (), jnp.float32)
+        llo = jnp.float32(math.log(float(self.lo)))
+        lhi = jnp.float32(math.log(float(self.hi)))
+        return jnp.exp(llo + (lhi - llo) * u)
+
+    def support(self):
+        return float(self.lo), float(self.hi)
+
+    def describe(self):
+        return {"dist": "loguniform", "lo": float(self.lo),
+                "hi": float(self.hi)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Normal(Prior):
+    """Gaussian ``N(mean, sigma^2)``; histogram support spans ±4 sigma
+    (tails clamp into the edge bins — see
+    :func:`psrsigsim_tpu.ops.fixed_histogram`)."""
+
+    mean: float
+    sigma: float
+
+    def __post_init__(self):
+        if not float(self.sigma) > 0.0:
+            raise ValueError(f"Normal needs sigma > 0, got {self.sigma}")
+
+    def sample(self, key, idx):
+        z = jax.random.normal(key, (), jnp.float32)
+        return jnp.float32(self.mean) + jnp.float32(self.sigma) * z
+
+    def support(self):
+        m, s = float(self.mean), float(self.sigma)
+        return m - 4.0 * s, m + 4.0 * s
+
+    def describe(self):
+        return {"dist": "normal", "mean": float(self.mean),
+                "sigma": float(self.sigma)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Grid(Prior):
+    """Deterministic grid sweep: trial ``i`` gets ``values[i % len]``.
+
+    The one prior that ignores its key — grids are for designed sweeps
+    where every trial's value must be knowable without running anything.
+    Combine with random priors on other knobs for stratified designs.
+    """
+
+    values: tuple
+
+    def __post_init__(self):
+        vals = tuple(float(v) for v in self.values)
+        if not vals:
+            raise ValueError("Grid needs at least one value")
+        object.__setattr__(self, "values", vals)
+
+    def sample(self, key, idx):
+        vals = jnp.asarray(self.values, jnp.float32)
+        return vals[jnp.mod(jnp.asarray(idx, jnp.int32), len(self.values))]
+
+    def support(self):
+        lo, hi = min(self.values), max(self.values)
+        if hi == lo:
+            hi = lo + max(abs(lo), 1.0)
+        return lo, hi
+
+    def describe(self):
+        return {"dist": "grid", "values": [float(v) for v in self.values]}
+
+
+@dataclasses.dataclass(frozen=True)
+class Choice(Prior):
+    """Random draw from a finite value set, optionally weighted."""
+
+    values: tuple
+    probs: tuple = None
+
+    def __post_init__(self):
+        vals = tuple(float(v) for v in self.values)
+        if not vals:
+            raise ValueError("Choice needs at least one value")
+        object.__setattr__(self, "values", vals)
+        if self.probs is not None:
+            p = tuple(float(x) for x in self.probs)
+            if len(p) != len(vals):
+                raise ValueError(
+                    f"Choice probs length {len(p)} != values length {len(vals)}")
+            tot = sum(p)
+            if not tot > 0:
+                raise ValueError("Choice probs must sum to a positive value")
+            object.__setattr__(self, "probs", tuple(x / tot for x in p))
+
+    def sample(self, key, idx):
+        vals = jnp.asarray(self.values, jnp.float32)
+        if self.probs is None:
+            j = jax.random.randint(key, (), 0, len(self.values))
+        else:
+            j = jax.random.choice(key, len(self.values),
+                                  p=jnp.asarray(self.probs, jnp.float32))
+        return vals[j]
+
+    def support(self):
+        lo, hi = min(self.values), max(self.values)
+        if hi == lo:
+            hi = lo + max(abs(lo), 1.0)
+        return lo, hi
+
+    def describe(self):
+        out = {"dist": "choice", "values": [float(v) for v in self.values]}
+        if self.probs is not None:
+            out["probs"] = [float(p) for p in self.probs]
+        return out
+
+
+_DISTS = {
+    "fixed": lambda s: Fixed(s["value"]),
+    "uniform": lambda s: Uniform(s["lo"], s["hi"]),
+    "loguniform": lambda s: LogUniform(s["lo"], s["hi"]),
+    "normal": lambda s: Normal(s["mean"], s["sigma"]),
+    "grid": lambda s: Grid(tuple(s["values"])),
+    "choice": lambda s: Choice(tuple(s["values"]),
+                               tuple(s["probs"]) if s.get("probs") else None),
+}
+
+
+def parse_prior(spec):
+    """Build a :class:`Prior` from its canonical spec dict (the CLI's
+    TOML/JSON form; inverse of :meth:`Prior.describe`)."""
+    if isinstance(spec, Prior):
+        return spec
+    if not isinstance(spec, dict) or "dist" not in spec:
+        raise ValueError(
+            f"prior spec must be a dict with a 'dist' key, got {spec!r}")
+    dist = str(spec["dist"]).lower()
+    maker = _DISTS.get(dist)
+    if maker is None:
+        raise ValueError(
+            f"unknown prior dist {dist!r}; known: {sorted(_DISTS)}")
+    try:
+        return maker(spec)
+    except KeyError as err:
+        raise ValueError(
+            f"prior spec {spec!r} missing required field {err}") from None

@@ -1,0 +1,792 @@
+"""Monte-Carlo study engine: parameter space -> in-graph trials -> results.
+
+The BASELINE north star names Monte-Carlo TOA-error studies as the reason
+the whole pipeline must be vmap-able; this module is the subsystem that
+actually turns a declared parameter space into results.  One trial is a
+complete in-graph program — prior sampling (:mod:`~psrsigsim_tpu.mc.priors`),
+pulse synthesis, ISM delays, radiometer noise, on-device fold, and
+:func:`~psrsigsim_tpu.ops.fftfit_shift` TOA measurement — vmapped over a
+trial chunk and sharded over the mesh's ``obs`` axis, so a 100k-trial
+sweep moves only a few floats per trial over the host link (the
+``(Nchan, Nsamp)`` blocks never leave the device).
+
+Reproducibility contract (the engine's foundation):
+
+* trial ``i``'s key is ``stage_key(jax.random.key(seed), "user", i)`` —
+  the SAME derivation :class:`~psrsigsim_tpu.parallel.FoldEnsemble` uses
+  for observation ``i``, so a study whose priors leave the profile
+  untouched can export its exact trials as PSRFITS through the existing
+  streaming exporter (:meth:`MonteCarloStudy.export_psrfits`);
+* parameters sample from per-trial folded keys (priors module), so every
+  quantity depends only on (seed, global trial index) — results are
+  independent of chunk size, mesh shape, and how often the sweep died.
+
+Streaming reduction: each chunk is reduced ON DEVICE to a per-trial
+metric row plus integer histogram counts and min/max — the host merges
+integers (exact, order-independent) and fills a trial-indexed metric
+matrix (order-independent by construction), so the merged summary
+statistics and the result artifact are bit-identical for ANY chunking.
+
+Resumable sweeps reuse the PR-2 journal discipline: per-chunk metric rows
+land in ``trials.f32`` (positional pwrite + fsync), then an fsync'd
+append-only journal line (sha256, histogram, min/max), then an atomic
+cursor — a SIGKILL at any point loses at most one uncommitted chunk, and
+the resumed run's artifact is byte-identical to an uninterrupted one
+(tests/test_mc.py, tests/mc_runner.py via the fault harness's ``mc.kill``
+point).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.stats import fixed_histogram
+from ..ops.toa import fftfit_combine, fftfit_shift
+from ..parallel.mesh import CHAN_AXIS, OBS_AXIS, make_mesh
+from ..simulate.pipeline import _chan_chi2, _dispersion_delays
+from ..utils.rng import stage_key
+from .priors import Prior, parse_prior
+
+try:  # jax >= 0.6 stable API, else the experimental home
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["MonteCarloStudy", "StudyManifestError", "KNOBS"]
+
+_MANIFEST_NAME = "study_manifest.json"
+_JOURNAL_NAME = "mc_journal.jsonl"
+_CURSOR_NAME = "mc_cursor.json"
+_TRIALS_RAW = "trials.f32"
+
+#: the physics/instrument knobs a prior may vary, and what each does to
+#: the trial program (all sampled in-graph, float32):
+#:
+#: ``dm``           dispersion measure (pc/cm^3) — replaces the base DM.
+#: ``tau_d_ms``     scattering tau at the band center (ms), scaled per
+#:                  channel by the Kolmogorov thin-screen law f^-4.4
+#:                  (models/ism scatter_delays_ms semantics) and added to
+#:                  the dispersion delays.
+#: ``width``        Gaussian profile width (phase turns) — switches the
+#:                  trial to an in-graph Gaussian portrait (peak 0.5).
+#: ``amp``          profile amplitude factor (with ``width``'s portrait;
+#:                  the signal-strength / S-over-N knob).
+#: ``noise_scale``  radiometer noise-norm factor (the receiver T_sys
+#:                  knob; noise_norm scales linearly with T_sys).
+#: ``null_frac``    per-subint nulling probability: nulled subints carry
+#:                  only radiometer noise.
+KNOBS = ("dm", "tau_d_ms", "width", "amp", "noise_scale", "null_frac")
+
+#: derived per-trial metrics appended after the sampled parameters:
+#: inverse-variance-combined TOA residual (turns, after subtracting the
+#: known delay curve), rms of per-channel residuals, combined reported
+#: sigma, and the mean fitted template amplitude.
+DERIVED_METRICS = ("toa_err", "toa_rms", "toa_sigma", "fit_amp")
+
+# Kolmogorov thin-screen scattering scaling: beta = 11/3 in
+# models/ism/ism.py _tau_d_exponent -> -2*beta/(beta-2) = -4.4
+_SCATTER_EXPONENT = -4.4
+
+# default histogram support of the derived metrics (phase turns are
+# bounded; tails clamp into edge bins — ops/stats.fixed_histogram)
+_DERIVED_RANGES = {
+    "toa_err": (-0.5, 0.5),
+    "toa_rms": (0.0, 0.5),
+    "toa_sigma": (0.0, 0.1),
+    "fit_amp": (0.0, 4.0),
+}
+
+
+class StudyManifestError(RuntimeError):
+    """``resume=True`` against an out_dir written by a DIFFERENT study.
+
+    Carries the per-field disagreement so an operator can tell a stale
+    out_dir from a config typo (mirrors
+    :class:`~psrsigsim_tpu.io.export.ExportManifestError`)."""
+
+    def __init__(self, out_dir, mismatches):
+        self.out_dir = out_dir
+        self.mismatches = dict(mismatches)
+        lines = [f"  - {k}: out_dir has {v[0]!r}, this run has {v[1]!r}"
+                 for k, v in sorted(self.mismatches.items())]
+        super().__init__(
+            f"out_dir {out_dir} holds a study with different parameters; "
+            "resuming would silently mix two sweeps.  Differing fields:\n"
+            + "\n".join(lines)
+            + "\nUse a fresh out_dir, or resume=False to overwrite.")
+
+
+def _load_journal(path):
+    """Valid committed-chunk records keyed by start index.
+
+    Append-only + fsync'd per commit: a crash leaves at most one torn
+    final line, which is skipped AND truncated away (appending after a
+    newline-less fragment would weld records — the same rule the run
+    supervisor applies to its journal)."""
+    done = {}
+    valid_end = 0
+    try:
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                valid_end += len(line)
+                if rec.get("e") == "chunk":
+                    done[int(rec["start"])] = rec
+    except FileNotFoundError:
+        return done
+    if valid_end < os.path.getsize(path):
+        with open(path, "rb+") as f:
+            f.truncate(valid_end)
+    return done
+
+
+class MonteCarloStudy:
+    """A declarative Monte-Carlo study over the fold-mode pipeline.
+
+    Parameters
+    ----------
+    cfg : :class:`~psrsigsim_tpu.simulate.pipeline.FoldPipelineConfig`
+        Static observation geometry (one compiled trial program per
+        chunk width derives from it).
+    profiles : array ``(Nchan, Nph)``
+        Base noise-free portrait (the trial template, unless a
+        ``width``/``amp`` prior switches to an in-graph Gaussian).
+    noise_norm : float
+        Base radiometer noise norm (scaled per trial by ``noise_scale``).
+    priors : dict ``{knob: Prior-or-spec-dict}``
+        What varies; knobs from :data:`KNOBS`.  An empty dict is legal
+        (a pure repeat-trial noise study).
+    seed : int
+        Study seed; trial keys derive as ``stage_key(key(seed), "user",
+        trial_index)``.
+    dm : float
+        Base DM when no ``dm`` prior is given.
+    mesh : jax.sharding.Mesh, optional
+        Defaults to all devices on the ``obs`` (trial) axis.
+    nharm : int, optional
+        FFTFIT harmonic cap (static; default all).
+    hist_bins : int
+        Fixed-bin histogram resolution of the streaming reduction.
+    hist_ranges : dict, optional
+        ``{metric: (lo, hi)}`` overrides of the default histogram
+        support (params default to their prior's support).
+    """
+
+    def __init__(self, cfg, profiles, noise_norm, priors, seed=0, dm=0.0,
+                 mesh=None, nharm=None, hist_bins=32, hist_ranges=None,
+                 base_width=0.05):
+        self.cfg = cfg
+        self._profiles_np = np.ascontiguousarray(profiles, np.float32)
+        self.noise_norm = float(noise_norm)
+        self.dm = float(dm)
+        self.seed = int(seed)
+        self.nharm = None if nharm is None else int(nharm)
+        self.hist_bins = int(hist_bins)
+        self.base_width = float(base_width)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._simulation = None
+
+        priors = {k: parse_prior(v) for k, v in dict(priors).items()}
+        unknown = set(priors) - set(KNOBS)
+        if unknown:
+            raise ValueError(
+                f"unknown study knob(s) {sorted(unknown)}; valid knobs: "
+                f"{list(KNOBS)}")
+        for k, v in priors.items():
+            if not isinstance(v, Prior):
+                raise TypeError(f"prior for {k!r} is not a Prior: {v!r}")
+        # stable slot order = KNOBS order, so a prior's key fold never
+        # depends on dict insertion order
+        self.param_names = tuple(k for k in KNOBS if k in priors)
+        self.priors = {k: priors[k] for k in self.param_names}
+        self.metric_names = self.param_names + DERIVED_METRICS
+
+        if getattr(cfg, "shift_mode", "envelope") != "envelope":
+            # the trial body mirrors _fold_core's ENVELOPE branch only; a
+            # config compiled for the exact-FFT mode (PSS_EXACT_SHIFT=1 /
+            # shift_mode="fft") would make the study silently measure
+            # different data than run()/export simulate, breaking the
+            # bit-identity and dataset-export contracts
+            raise ValueError(
+                "MonteCarloStudy implements the envelope-mode trial "
+                f"program only; cfg.shift_mode={cfg.shift_mode!r}. Build "
+                "the config with shift_mode='envelope' (unset "
+                "PSS_EXACT_SHIFT) to run studies.")
+        nchan = cfg.meta.nchan
+        n_chan_shards = self.mesh.shape[CHAN_AXIS]
+        if nchan % n_chan_shards:
+            raise ValueError(
+                f"Nchan={nchan} must be divisible by the chan mesh axis "
+                f"({n_chan_shards})")
+        if n_chan_shards > 1:
+            # fftfit's channel combine is a cross-channel reduction; the
+            # trial program keeps channels device-local by design
+            raise ValueError(
+                "MonteCarloStudy shards trials only: use a mesh with "
+                "chan axis 1 (the default make_mesh())")
+
+        self._hist_ranges = {}
+        overrides = dict(hist_ranges or {})
+        for name in self.metric_names:
+            if name in overrides:
+                lo, hi = overrides.pop(name)
+            elif name in self.priors:
+                lo, hi = self.priors[name].support()
+            else:
+                lo, hi = _DERIVED_RANGES[name]
+            lo, hi = float(lo), float(hi)
+            if not hi > lo:
+                raise ValueError(f"hist range for {name}: hi must exceed lo")
+            self._hist_ranges[name] = (lo, hi)
+        if overrides:
+            raise ValueError(
+                f"hist_ranges for unknown metrics: {sorted(overrides)}")
+
+        self._tau_ref_mhz = float(cfg.meta.fcent_mhz)
+        freqs = np.asarray(cfg.meta.dat_freq_mhz(), np.float32)
+        chan_sh = NamedSharding(self.mesh, P(CHAN_AXIS))
+        self._profiles_dev = jax.device_put(
+            self._profiles_np, NamedSharding(self.mesh, P(CHAN_AXIS, None)))
+        self._freqs_dev = jax.device_put(freqs, chan_sh)
+        self._chan_ids_dev = jax.device_put(np.arange(nchan), chan_sh)
+        self._obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
+        self._programs = {}   # chunk width -> jitted chunk program
+        self._param_fn = None  # jitted sampled-params program (lazy)
+
+    # -- construction bridges ---------------------------------------------
+
+    @classmethod
+    def from_simulation(cls, sim, priors, seed=0, mesh=None, **kw):
+        """Build from a configured :class:`~psrsigsim_tpu.simulate.Simulation`
+        (runs ``init_all`` + ``build_fold_config``); keeps the simulation
+        for :meth:`export_psrfits`."""
+        from ..simulate.pipeline import build_fold_config
+
+        sim.init_all()
+        cfg, profiles, noise_norm = build_fold_config(
+            sim.signal, sim.pulsar, sim.tscope, sim.system_name)
+        dm = float(sim.signal.dm.value) if sim.signal.dm is not None else 0.0
+        study = cls(cfg, profiles, noise_norm, priors, seed=seed, dm=dm,
+                    mesh=mesh, **kw)
+        study._simulation = sim
+        return study
+
+    # -- the in-graph trial -----------------------------------------------
+
+    def _sample_params(self, key, idx):
+        """All prior draws for one trial: key fold is (trial key ->
+        "prior" stage -> parameter slot), so adding/removing one prior
+        never perturbs another's stream."""
+        pk = stage_key(key, "prior")
+        out = {}
+        for slot, name in enumerate(self.param_names):
+            out[name] = self.priors[name].sample(
+                jax.random.fold_in(pk, slot), idx)
+        return out
+
+    def _trial_block(self, key, idx, profiles, freqs, chan_ids):
+        """One trial's simulated block ``(Nchan, Nsamp)`` + its delay
+        curve and template.  Mirrors ``simulate.pipeline._fold_core``'s
+        envelope branch op for op (same stage keys, same sampler entry
+        points), so a study whose priors touch only dm/noise is
+        bit-identical to :func:`fold_pipeline` — pinned by
+        tests/test_mc.py."""
+        cfg = self.cfg
+        nsamp = cfg.nsub * cfg.nph
+        p = self._sample_params(key, idx)
+
+        dm = p.get("dm", jnp.float32(self.dm))
+        extra = None
+        if "tau_d_ms" in p:
+            extra = p["tau_d_ms"] * (
+                freqs / jnp.float32(self._tau_ref_mhz)
+            ) ** jnp.float32(_SCATTER_EXPONENT)
+        if "width" in p or "amp" in p:
+            width = p.get("width", jnp.float32(self.base_width))
+            amp = p.get("amp", jnp.float32(1.0))
+            ph = (jnp.arange(cfg.nph, dtype=jnp.float32) + 0.5) / cfg.nph
+            row = amp * jnp.exp(-0.5 * ((ph - 0.5) / width) ** 2)
+            prof = jnp.broadcast_to(row[None, :],
+                                    (profiles.shape[0], cfg.nph))
+        else:
+            prof = profiles
+
+        from ..ops.shift import fourier_shift
+
+        kp = stage_key(key, "pulse")
+        kn = stage_key(key, "noise")
+        delays_ms = _dispersion_delays(dm, freqs, extra)
+        shifted = fourier_shift(prof, delays_ms, dt=cfg.dt_ms)
+        block = jnp.tile(shifted, (1, cfg.nsub))
+        block = block * _chan_chi2(kp, chan_ids, cfg.nfold, nsamp) \
+            * cfg.draw_norm
+        if "null_frac" in p:
+            ksel = stage_key(key, "null_select")
+            u = jax.random.uniform(ksel, (cfg.nsub,), jnp.float32)
+            live = (u >= p["null_frac"]).astype(jnp.float32)
+            block = (block.reshape(-1, cfg.nsub, cfg.nph)
+                     * live[None, :, None]).reshape(-1, nsamp)
+        nn = jnp.float32(self.noise_norm) * p.get("noise_scale",
+                                                  jnp.float32(1.0))
+        block = block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * nn
+        return block, delays_ms, prof, p
+
+    def _trial_metrics(self, key, idx, profiles, freqs, chan_ids):
+        """One trial reduced to its metric row: fold on device, FFTFIT
+        every channel against the trial's own template, subtract the
+        known delay curve, combine across the band."""
+        cfg = self.cfg
+        block, delays_ms, prof, p = self._trial_block(
+            key, idx, profiles, freqs, chan_ids)
+        folded = block.reshape(-1, cfg.nsub, cfg.nph).sum(axis=1)
+        s, e, b = jax.vmap(
+            lambda pr, tm: fftfit_shift(pr, tm, nharm=self.nharm)
+        )(folded, prof)
+        period_ms = jnp.float32(cfg.period_s * 1e3)
+        expect = jnp.mod(delays_ms / period_ms + 0.5, 1.0) - 0.5
+        resid = jnp.mod(s - expect + 0.5, 1.0) - 0.5
+        comb, comb_sigma = fftfit_combine(resid, e)
+        rms = jnp.sqrt(jnp.mean(resid ** 2))
+        vals = [p[n] for n in self.param_names]
+        vals += [comb, rms, comb_sigma, jnp.mean(b)]
+        return jnp.stack([jnp.asarray(v, jnp.float32) for v in vals])
+
+    # -- compiled chunk programs ------------------------------------------
+
+    def _program(self, width):
+        """One jitted sharded program per chunk width: trials -> metric
+        rows (sharded vmap) + in-graph histogram/min/max reduction."""
+        prog = self._programs.get(width)
+        if prog is not None:
+            return prog
+        mesh = self.mesh
+        nbins = self.hist_bins
+        los = jnp.asarray([self._hist_ranges[m][0]
+                           for m in self.metric_names], jnp.float32)
+        his = jnp.asarray([self._hist_ranges[m][1]
+                           for m in self.metric_names], jnp.float32)
+
+        def _local(keys, idxs, profiles, freqs, chan_ids):
+            return jax.vmap(
+                lambda k, i: self._trial_metrics(k, i, profiles, freqs,
+                                                 chan_ids)
+            )(keys, idxs)
+
+        # check_rep=False: the metric row REDUCES the channel axis, which
+        # the rep-checker cannot prove replicated over 'chan' — but the
+        # constructor enforces a size-1 chan axis for studies, so the
+        # output is trivially replicated there
+        sharded = shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(OBS_AXIS), P(OBS_AXIS), P(CHAN_AXIS, None),
+                      P(CHAN_AXIS), P(CHAN_AXIS)),
+            out_specs=P(OBS_AXIS, None),
+            check_rep=False,
+        )
+
+        @jax.jit
+        def chunk_program(keys, idxs, count, profiles, freqs, chan_ids):
+            metrics = sharded(keys, idxs, profiles, freqs, chan_ids)
+            valid = jnp.arange(width) < count   # padded tail rows
+            w = valid.astype(jnp.int32)
+            cols = metrics.T
+            hist = jax.vmap(
+                lambda c, lo, hi: fixed_histogram(c, lo, hi, nbins,
+                                                  weights=w)
+            )(cols, los, his)
+            inf = jnp.float32(jnp.inf)
+            mn = jnp.min(jnp.where(valid[None, :], cols, inf), axis=1)
+            mx = jnp.max(jnp.where(valid[None, :], cols, -inf), axis=1)
+            return metrics, hist, mn, mx
+
+        self._programs[width] = chunk_program
+        return chunk_program
+
+    def _chunk_inputs(self, start, n_trials, width):
+        """Keys + global indices for one chunk, placed with the trial
+        sharding.  Indices wrap modulo ``n_trials`` (the ensemble's
+        padding rule); wrapped rows are masked out of the reduction and
+        trimmed before the matrix fill."""
+        idx = (start + np.arange(width)) % n_trials
+        root = jax.random.key(self.seed)
+        idx_j = jnp.asarray(idx, jnp.int32)
+        keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx_j)
+        return (jax.device_put(keys, self._obs_sharding),
+                jax.device_put(idx_j, self._obs_sharding))
+
+    # -- fingerprint / manifest -------------------------------------------
+
+    def fingerprint(self, n_trials):
+        """Canonical study fingerprint: everything that defines the
+        sweep's OUTPUT (and nothing that doesn't — chunk size, mesh and
+        writer knobs are deliberately absent, they cannot change the
+        bytes)."""
+        cfg = self.cfg
+        return {
+            "kind": "mc_study",
+            "n_trials": int(n_trials),
+            "seed": int(self.seed),
+            "priors": {k: self.priors[k].describe()
+                       for k in self.param_names},
+            "metrics": list(self.metric_names),
+            "hist_bins": int(self.hist_bins),
+            "hist_ranges": {m: [self._hist_ranges[m][0],
+                                self._hist_ranges[m][1]]
+                            for m in self.metric_names},
+            "nharm": self.nharm,
+            "base_width": self.base_width,
+            "config": {
+                "nchan": int(cfg.meta.nchan),
+                "nph": int(cfg.nph),
+                "nsub": int(cfg.nsub),
+                "nfold": float(cfg.nfold),
+                "noise_df": float(cfg.noise_df),
+                "dt_ms": float(cfg.dt_ms),
+                "period_s": float(cfg.period_s),
+                "draw_norm": float(cfg.draw_norm),
+                "dm": float(self.dm),
+                "noise_norm": float(self.noise_norm),
+                "tau_ref_mhz": float(self._tau_ref_mhz),
+                "profiles_sha256": hashlib.sha256(
+                    self._profiles_np.tobytes()).hexdigest(),
+            },
+        }
+
+    @staticmethod
+    def _check_manifest(out_dir, fp, resume):
+        from ..io.export import _atomic_write_json
+
+        path = os.path.join(out_dir, _MANIFEST_NAME)
+        old = None
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    old = json.load(f)
+            except json.JSONDecodeError:
+                if resume:
+                    raise RuntimeError(
+                        f"manifest {path} exists but is unreadable; cannot "
+                        "prove the out_dir holds this study. Use "
+                        "resume=False to overwrite, or a fresh out_dir.")
+        if old is not None and resume:
+            mismatches = {k: (old.get(k), fp[k])
+                          for k in fp if old.get(k) != fp[k]}
+            if mismatches:
+                raise StudyManifestError(out_dir, mismatches)
+            merged = {**{k: v for k, v in old.items() if k not in fp}, **fp}
+        else:
+            merged = dict(fp)
+        _atomic_write_json(path, merged, indent=1)
+
+    # -- the sweep ---------------------------------------------------------
+
+    def run(self, n_trials, chunk_size=256, out_dir=None, resume=True,
+            telemetry=None, progress=None, faults=None, keep_trials=True,
+            _stop_after_chunks=None):
+        """Run (or resume) the sweep; returns a
+        :class:`~psrsigsim_tpu.mc.StudyResult`.
+
+        Args:
+            n_trials: total trials of the study.
+            chunk_size: trials per compiled dispatch (rounds up to the
+                mesh's obs-shard count; every value yields bit-identical
+                results — the invariance tests pin it).
+            out_dir: enables the crash-safe journal + the result
+                artifact (``study_result.json`` + ``trials.npy``); None
+                runs in memory.
+            resume: skip chunks the journal records as committed
+                (verified by sha256 against ``trials.f32``); ``False``
+                starts clean.
+            telemetry: optional
+                :class:`~psrsigsim_tpu.runtime.StageTimers` (stages
+                dispatch/fetch/reduce/write; one is created otherwise
+                and lands on the result + manifest).
+            progress: optional callable ``progress(done, total)``.
+            faults: optional
+                :class:`~psrsigsim_tpu.runtime.FaultPlan` (tests only;
+                arms the ``mc.kill`` point).
+            keep_trials: write the per-trial metric matrix into the
+                artifact (tiny — a few floats per trial — and what
+                makes exact percentile/ECDF queries possible).
+            _stop_after_chunks: TESTING hook — stop cleanly after N
+                fresh chunk commits (simulating an interrupted sweep
+                without a subprocess); returns None.
+        """
+        import time as _time
+
+        from ..runtime.faults import crash_process
+        from ..runtime.telemetry import StageTimers
+        from .results import StudyResult
+
+        n_trials = int(n_trials)
+        if n_trials <= 0:
+            raise ValueError("n_trials must be positive")
+        if telemetry is None:
+            telemetry = StageTimers(extra_stages=("reduce",))
+        M = len(self.metric_names)
+        n_shards = self.mesh.shape[OBS_AXIS]
+        chunk_size = min(int(chunk_size), n_trials)
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be positive")
+        chunk_size += (-chunk_size) % n_shards
+        width = chunk_size
+        prog = self._program(width)
+
+        matrix = np.empty((n_trials, M), np.float32)
+        hist_tot = np.zeros((M, self.hist_bins), np.int64)
+        mn_tot = np.full(M, np.inf, np.float32)
+        mx_tot = np.full(M, -np.inf, np.float32)
+
+        journal_f = raw_fd = None
+        done = {}
+        if out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            self._check_manifest(out_dir, self.fingerprint(n_trials), resume)
+            journal_path = os.path.join(out_dir, _JOURNAL_NAME)
+            cursor_path = os.path.join(out_dir, _CURSOR_NAME)
+            raw_path = os.path.join(out_dir, _TRIALS_RAW)
+            if not resume:
+                for p in (journal_path, cursor_path, raw_path):
+                    try:
+                        os.unlink(p)
+                    except FileNotFoundError:
+                        pass
+            else:
+                done = _load_journal(journal_path)
+            raw_fd = os.open(raw_path, os.O_RDWR | os.O_CREAT, 0o644)
+            journal_f = open(journal_path, "a")
+
+        commits = 0
+        done_trials = 0
+
+        def _report(count):
+            nonlocal done_trials
+            done_trials += count
+            if progress is not None:
+                progress(done_trials, n_trials)
+
+        def _merge(start, count, rows, hist, mn, mx):
+            nonlocal hist_tot, mn_tot, mx_tot
+            t0 = _time.perf_counter()
+            matrix[start:start + count] = rows
+            hist_tot += np.asarray(hist, np.int64)
+            mn_tot = np.minimum(mn_tot, mn)
+            mx_tot = np.maximum(mx_tot, mx)
+            telemetry.add("reduce", _time.perf_counter() - t0)
+
+        def _resume_chunk(start, count, rec):
+            """A journaled chunk: reload its rows from trials.f32 (sha-
+            verified) and its integer accumulators from the journal line;
+            returns False when the record does not check out (the chunk
+            then recomputes — identical bytes land back in place)."""
+            if int(rec.get("count", -1)) != count:
+                return False
+            nbytes = count * M * 4
+            blob = os.pread(raw_fd, nbytes, start * M * 4)
+            if len(blob) != nbytes:
+                return False
+            if hashlib.sha256(blob).hexdigest() != rec.get("sha"):
+                return False
+            rows = np.frombuffer(blob, np.float32).reshape(count, M)
+            hist = np.asarray(rec["hist"], np.int64).reshape(
+                M, self.hist_bins)
+            mn = np.asarray(rec["mn"], np.float32)
+            mx = np.asarray(rec["mx"], np.float32)
+            _merge(start, count, rows, hist, mn, mx)
+            return True
+
+        def _commit(start, count, rows, hist, mn, mx):
+            """Durable record of one fresh chunk: rows land positionally
+            in trials.f32 (pwrite + fsync), THEN the journal line, THEN
+            the atomic cursor — a SIGKILL leaves either a committed
+            record or none, never a half-trusted one."""
+            nonlocal commits
+            if raw_fd is None:
+                return
+            t0 = _time.perf_counter()
+            blob = rows.tobytes()
+            os.pwrite(raw_fd, blob, start * M * 4)
+            os.fsync(raw_fd)
+            rec = {"e": "chunk", "start": int(start), "count": int(count),
+                   "sha": hashlib.sha256(blob).hexdigest(),
+                   "hist": [int(v) for v in np.asarray(hist).reshape(-1)],
+                   "mn": [float(v) for v in mn],
+                   "mx": [float(v) for v in mx]}
+            journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
+            journal_f.flush()
+            os.fsync(journal_f.fileno())
+            from ..io.export import _atomic_write_json
+
+            commits += 1
+            _atomic_write_json(cursor_path, {
+                "commits": commits, "journal_bytes": journal_f.tell()})
+            telemetry.add("write", _time.perf_counter() - t0)
+            if faults is not None:
+                cfg = faults.config("mc.kill")
+                if cfg is not None:
+                    after = cfg.get("after_start")
+                    if after is None or after == start:
+                        if faults.fire("mc.kill", token=f"start={start}"):
+                            crash_process()
+
+        def _dispatch(start, count):
+            t0 = _time.perf_counter()
+            keys, idxs = self._chunk_inputs(start, n_trials, width)
+            out = prog(keys, idxs, jnp.int32(count), self._profiles_dev,
+                       self._freqs_dev, self._chan_ids_dev)
+            telemetry.add("dispatch", _time.perf_counter() - t0)
+            return out
+
+        def _fetch(dev):
+            t0 = _time.perf_counter()
+            host = jax.device_get(dev)
+            telemetry.add("fetch", _time.perf_counter() - t0,
+                          nbytes=sum(np.asarray(a).nbytes for a in host))
+            return host
+
+        stopped = False
+        try:
+            # dispatch-ahead of one chunk: the device computes chunk N+1
+            # while the host merges/journals chunk N
+            inflight = []  # [(start, count, device futures)]
+
+            def _drain_one():
+                nonlocal stopped
+                s0, c0, dev = inflight.pop(0)
+                metrics, hist, mn, mx = _fetch(dev)
+                rows = np.ascontiguousarray(metrics[:c0])
+                _merge(s0, c0, rows, hist, mn, mx)
+                _commit(s0, c0, rows, hist, mn, mx)
+                _report(c0)
+                if (_stop_after_chunks is not None
+                        and commits >= _stop_after_chunks):
+                    stopped = True
+
+            for start in range(0, n_trials, chunk_size):
+                count = min(chunk_size, n_trials - start)
+                rec = done.get(start)
+                if rec is not None and _resume_chunk(start, count, rec):
+                    _report(count)
+                    continue
+                inflight.append((start, count, _dispatch(start, count)))
+                if len(inflight) > 1:
+                    _drain_one()
+                    if stopped:
+                        return None
+            while inflight:
+                _drain_one()
+                if stopped:
+                    return None
+        finally:
+            if journal_f is not None:
+                journal_f.close()
+            if raw_fd is not None:
+                os.close(raw_fd)
+
+        result = StudyResult(
+            metric_names=self.metric_names,
+            param_names=self.param_names,
+            metrics=matrix,
+            hist=hist_tot,
+            hist_ranges=dict(self._hist_ranges),
+            minmax=(mn_tot, mx_tot),
+            spec=self.fingerprint(n_trials),
+            telemetry=telemetry.snapshot(),
+        )
+        if out_dir is not None:
+            result.save(out_dir, keep_trials=keep_trials)
+        return result
+
+    # -- host-side conveniences -------------------------------------------
+
+    def sampled_params(self, n_trials, chunk=4096):
+        """The FULL per-trial parameter table ``(n_trials, n_params)`` as
+        host float32 — computed by the same in-graph sampling the trial
+        program runs (bit-identical values), in chunks so huge sweeps
+        never build one giant program."""
+        names = self.param_names
+        if not names:
+            return np.zeros((int(n_trials), 0), np.float32)
+
+        if self._param_fn is None:
+            def one(k, i):
+                p = self._sample_params(k, i)
+                return jnp.stack([jnp.asarray(p[n], jnp.float32)
+                                  for n in names])
+
+            self._param_fn = jax.jit(jax.vmap(one))
+        _params = self._param_fn
+        root = jax.random.key(self.seed)
+        out = np.empty((int(n_trials), len(names)), np.float32)
+        for start in range(0, int(n_trials), chunk):
+            idx = np.arange(start, min(start + chunk, int(n_trials)))
+            idx_j = jnp.asarray(idx, jnp.int32)
+            keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx_j)
+            out[idx[0]:idx[-1] + 1] = np.asarray(_params(keys, idx_j))
+        return out
+
+    def export_psrfits(self, n_trials, out_dir, template, *,
+                       supervised=True, **export_kw):
+        """Export the study's trials as PSRFITS through the existing
+        streaming exporter — the dataset-generation exit path.
+
+        Valid when the priors leave the pulse profile and nulling alone
+        (``dm`` / ``noise_scale`` / ``tau_d_ms``-free subsets): trial
+        keys equal ensemble observation keys, so the exported files ARE
+        the study's trials bit-for-bit (same seed, with the sampled DMs
+        and noise norms passed per observation).  Requires
+        :meth:`from_simulation` construction.  The export manifest is
+        stamped with this study's fingerprint digest (``mc_study`` key).
+        """
+        if self._simulation is None:
+            raise RuntimeError(
+                "export_psrfits needs a study built via from_simulation "
+                "(the exporter rebuilds the ensemble from the Simulation)")
+        unsupported = set(self.param_names) - {"dm", "noise_scale"}
+        if unsupported:
+            raise NotImplementedError(
+                f"PSRFITS trial export supports only dm/noise_scale "
+                f"priors (the ensemble's per-observation inputs); got "
+                f"{sorted(unsupported)}")
+        params = self.sampled_params(n_trials)
+        dms = None
+        noise_norms = None
+        for j, name in enumerate(self.param_names):
+            if name == "dm":
+                dms = np.asarray(params[:, j], np.float64)
+            elif name == "noise_scale":
+                # multiply in float32, exactly as the in-graph trial does
+                # (f32 base * f32 scale): a float64 host product can round
+                # differently by one ulp, and the exported stream must be
+                # the trial's stream bit-for-bit
+                noise_norms = np.asarray(
+                    np.float32(self.noise_norm) * params[:, j], np.float64)
+        spec_digest = hashlib.sha256(
+            json.dumps(self.fingerprint(n_trials),
+                       sort_keys=True).encode()).hexdigest()
+        ens = self._simulation.to_ensemble(mesh=self.mesh)
+        common = dict(seed=self.seed, dms=dms, noise_norms=noise_norms,
+                      manifest_extra={"mc_study": spec_digest}, **export_kw)
+        if supervised:
+            from ..runtime import supervised_export
+
+            return supervised_export(ens, int(n_trials), out_dir, template,
+                                     ens.pulsar, **common)
+        from ..io.export import export_ensemble_psrfits
+
+        return export_ensemble_psrfits(ens, int(n_trials), out_dir,
+                                       template, ens.pulsar, **common)

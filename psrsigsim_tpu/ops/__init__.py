@@ -15,8 +15,8 @@ from .shift import (
     coherent_dedispersion_transfer,
     fourier_shift,
 )
-from .stats import chi2_draw_norm, chi2_sample, normal_sample
-from .toa import fftfit_batch, fftfit_shift
+from .stats import chi2_draw_norm, chi2_sample, fixed_histogram, normal_sample
+from .toa import fftfit_batch, fftfit_combine, fftfit_shift
 from .window import (
     fold_periods,
     offpulse_window,
@@ -38,6 +38,8 @@ __all__ = [
     "chi2_draw_norm",
     "fftfit_shift",
     "fftfit_batch",
+    "fftfit_combine",
+    "fixed_histogram",
     "block_downsample",
     "rebin",
     "clip_cast",

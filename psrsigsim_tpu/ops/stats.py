@@ -21,7 +21,7 @@ from jax import lax
 __all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm",
            "SEQ_RNG_BLOCK", "blocked_chan_chi2", "blocked_chan_normal",
            "sampler_backend", "chan_chi2_field", "chan_normal_field",
-           "flat_normal_field", "FLAT_TILE"]
+           "flat_normal_field", "FLAT_TILE", "fixed_histogram"]
 
 # Fixed span of global time samples per RNG key: ALL pipeline draws —
 # unsharded and sequence-sharded alike — are keyed by
@@ -298,6 +298,44 @@ def flat_normal_field(key, f0, length):
     if isinstance(off, int) and off == 0 and flat.shape[0] == length:
         return flat
     return lax.dynamic_slice(flat, (jnp.asarray(off, jnp.int32),), (length,))
+
+
+def fixed_histogram(x, lo, hi, nbins, weights=None):
+    """In-graph fixed-bin histogram: int32 counts of ``x`` over ``nbins``
+    equal bins spanning ``[lo, hi)``.
+
+    The Monte-Carlo study engine's streaming reduction primitive
+    (:mod:`psrsigsim_tpu.mc`): per-chunk counts are INTEGERS, so host
+    merges are exact additions and the merged histogram is bit-identical
+    for any chunking of the trial axis — the property float accumulators
+    cannot give.  Out-of-range values clamp into the edge bins (the study
+    engine sizes bins from each prior's declared support, so clamping
+    records genuine tail mass rather than dropping it silently).
+
+    Args:
+        x: values, any shape (flattened).
+        lo / hi: bin-range bounds (may be traced; ``hi > lo``).
+        nbins: static bin count.
+        weights: optional int weights shaped like ``x`` (0/1 validity
+            masks for padded batch rows); default all-ones.
+
+    Returns:
+        ``(nbins,)`` int32 counts.
+    """
+    x = jnp.asarray(x, jnp.float32).reshape(-1)
+    lo = jnp.asarray(lo, jnp.float32)
+    hi = jnp.asarray(hi, jnp.float32)
+    nbins = int(nbins)
+    if nbins <= 0:
+        raise ValueError(f"nbins={nbins} must be positive")
+    span = jnp.maximum(hi - lo, jnp.float32(1e-30))
+    idx = jnp.floor((x - lo) / span * nbins).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, nbins - 1)
+    if weights is None:
+        w = jnp.ones(x.shape, jnp.int32)
+    else:
+        w = jnp.asarray(weights, jnp.int32).reshape(-1)
+    return jnp.zeros((nbins,), jnp.int32).at[idx].add(w)
 
 
 def chi2_draw_norm(dtype, df):  # psrlint: disable=PSR102 (host-side staging helper)

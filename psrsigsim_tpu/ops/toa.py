@@ -37,7 +37,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fftfit_shift", "fftfit_batch"]
+__all__ = ["fftfit_shift", "fftfit_batch", "fftfit_combine"]
 
 _UPSAMPLE = 16
 _NEWTON_STEPS = 6
@@ -114,6 +114,35 @@ def fftfit_shift(profile, template, nharm=None):
     curv = 2.0 * b * b * jnp.sum(sel * (w * jnp.abs(T)) ** 2)
     sigma = jnp.sqrt(sigma2_n / jnp.maximum(curv, 1e-30))
     return tau, sigma, b
+
+
+def fftfit_combine(shifts, sigmas, axis=-1):
+    """Inverse-variance combination of per-channel FFTFIT measurements.
+
+    The standard frequency-collapse of a multi-channel TOA fit: channel
+    shifts (already wrapped to ``[-0.5, 0.5)`` turns and referenced to a
+    common fiducial, e.g. after subtracting the known dispersion delay)
+    combine with weights ``1/sigma^2``; the combined uncertainty is
+    ``1/sqrt(sum 1/sigma^2)``.  A plain weighted mean, valid when the
+    residuals cluster well inside a turn — which is what a TOA study
+    measures (the Monte-Carlo engine feeds residuals, not raw shifts).
+
+    Args:
+        shifts: per-channel phase shifts (turns), any shape.
+        sigmas: matching per-channel uncertainties (turns).
+        axis: channel axis to collapse (default last).
+
+    Returns:
+        ``(shift, sigma)`` with that axis reduced.  Zero/non-finite
+        sigmas are guarded to a tiny floor so a pathological channel
+        dominates (correctly) instead of producing NaN weights.
+    """
+    shifts = jnp.asarray(shifts, jnp.float32)
+    sigmas = jnp.asarray(sigmas, jnp.float32)
+    w = 1.0 / jnp.maximum(sigmas, 1e-12) ** 2
+    wsum = jnp.sum(w, axis=axis)
+    comb = jnp.sum(w * shifts, axis=axis) / jnp.maximum(wsum, 1e-30)
+    return comb, 1.0 / jnp.sqrt(jnp.maximum(wsum, 1e-30))
 
 
 def fftfit_batch(profiles, template, nharm=None):

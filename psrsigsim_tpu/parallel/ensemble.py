@@ -613,6 +613,23 @@ class FoldEnsemble:
             stop.set()
             thread.join(timeout=10.0)
 
+    def to_mc_study(self, priors, seed=0, **kw):
+        """Bridge to the Monte-Carlo study engine: a
+        :class:`~psrsigsim_tpu.mc.MonteCarloStudy` over THIS ensemble's
+        compiled configuration (same cfg/portrait/noise norm, same mesh).
+
+        Trial keys equal this ensemble's observation keys — study trial
+        ``i`` with priors over dm/noise draws the same pulse and noise
+        streams as ``run(n_obs, seed)``'s observation ``i`` — so a study
+        and a dataset export of the same seed describe the same
+        observations (``priors``: :data:`psrsigsim_tpu.mc.KNOBS`).
+        """
+        from ..mc import MonteCarloStudy
+
+        return MonteCarloStudy(self.cfg, np.asarray(self._profiles),
+                               self.noise_norm, priors, seed=seed,
+                               dm=self.dm, mesh=self.mesh, **kw)
+
     def signal_shell(self):
         """The configured signal object (metadata only — no ensemble data
         lives on it).  Used by the PSRFITS bulk exporter

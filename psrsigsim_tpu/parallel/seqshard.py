@@ -1,10 +1,15 @@
 """Sequence (time-axis) parallelism for SEARCH-mode streams.
 
 The reference's long axis is time: single-pulse mode generates "a large
-amount of data" (reference: signal/fb_signal.py:53) and marks the missing
-chunking with a TODO (reference: pulsar.py:171,235).  SURVEY §5 calls the
-``Nsamp`` axis this domain's analog of context parallelism; this module
-makes it first-class, the all-to-all (Ulysses-style) way:
+amount of data" (reference: signal/fb_signal.py:53), and the reference
+left its planned host-side chunked generation unimplemented (TODO
+markers at reference pulsar.py:171,235).  This framework deliberately
+does NOT reproduce that host-chunking design: long streams are instead
+DEVICE-sharded over a mesh (the divergence is ledgered — DIVERGENCES.md
+#27), with draws keyed by global RNG block so any shard count yields the
+same stream.  SURVEY §5 calls the ``Nsamp`` axis this domain's analog of
+context parallelism; this module makes it first-class, the all-to-all
+(Ulysses-style) way:
 
 * **Time-sharded stages** — pulse synthesis, nulling masks, radiometer
   noise are elementwise in time, so each device owns a ``(Nchan, T/n)``

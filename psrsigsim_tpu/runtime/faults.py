@@ -32,6 +32,14 @@ point                     where it fires
                           kill/resume tests).  Config:
                           ``{"after_start": int}``; omit ``after_start`` to
                           kill after the first commit of any kind.
+``mc.kill``               the Monte-Carlo study engine
+                          (:meth:`psrsigsim_tpu.mc.MonteCarloStudy.run`),
+                          immediately after the journal commit of the
+                          trial chunk starting at ``after_start`` —
+                          SIGKILLs the sweeping process (the preempted-
+                          host case for the study's kill/resume tests).
+                          Config: ``{"after_start": int}``; omit to kill
+                          after the first chunk commit.
 ========================  ====================================================
 
 Arming is explicit and local: a :class:`FaultPlan` is built by a test and
@@ -56,7 +64,7 @@ import signal
 __all__ = ["FaultPlan", "should_fire", "crash_process", "POINTS"]
 
 POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
-          "run.kill")
+          "run.kill", "mc.kill")
 
 
 class FaultPlan:

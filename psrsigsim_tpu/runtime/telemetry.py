@@ -33,21 +33,36 @@ STAGES = ("dispatch", "fetch", "encode", "write")
 
 
 class StageTimers:
-    """Monotonic per-stage busy-time accumulator for one export run."""
+    """Monotonic per-stage busy-time accumulator for one export run.
 
-    def __init__(self):
+    ``extra_stages`` declares additional stage names beyond the export
+    pipeline's canonical four — the Monte-Carlo study engine reports its
+    host-side accumulator merge as ``"reduce"`` — so a consumer with a
+    different pipeline shape reuses the same accumulator, snapshot
+    format, and bottleneck logic instead of growing a parallel one.
+    """
+
+    def __init__(self, extra_stages=()):
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
-        self._seconds = {k: 0.0 for k in STAGES}
-        self._calls = {k: 0 for k in STAGES}
+        self._stages = tuple(STAGES) + tuple(
+            s for s in extra_stages if s not in STAGES)
+        self._seconds = {k: 0.0 for k in self._stages}
+        self._calls = {k: 0 for k in self._stages}
         self._bytes_fetched = 0
         self._depths = {}  # queue name -> [sum, samples, max]
 
     def add(self, stage, seconds, nbytes=0):
         """Accumulate ``seconds`` of busy time against ``stage`` (one of
-        :data:`STAGES`); ``nbytes`` counts device->host payload bytes
-        (fetch stage only, by convention)."""
+        :data:`STAGES` or a declared extra stage; an undeclared name is
+        registered on first use so a shared timer object never throws
+        from a reporting thread); ``nbytes`` counts device->host payload
+        bytes (fetch stage only, by convention)."""
         with self._lock:
+            if stage not in self._seconds:
+                self._stages = self._stages + (stage,)
+                self._seconds[stage] = 0.0
+                self._calls[stage] = 0
             self._seconds[stage] += float(seconds)
             self._calls[stage] += 1
             self._bytes_fetched += int(nbytes)
@@ -70,7 +85,7 @@ class StageTimers:
         other stage hides under it)."""
         with self._lock:
             out = {}
-            for k in STAGES:
+            for k in self._stages:
                 out[f"{k}_s"] = round(self._seconds[k], 6)
                 out[f"{k}_calls"] = self._calls[k]
             out["bytes_fetched"] = self._bytes_fetched
@@ -78,5 +93,6 @@ class StageTimers:
             for name, (tot, n, mx) in sorted(self._depths.items()):
                 out[f"{name}_depth_max"] = mx
                 out[f"{name}_depth_mean"] = round(tot / max(n, 1), 3)
-            out["bottleneck"] = max(STAGES, key=lambda k: self._seconds[k])
+            out["bottleneck"] = max(self._stages,
+                                    key=lambda k: self._seconds[k])
             return out

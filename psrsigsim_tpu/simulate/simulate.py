@@ -343,6 +343,29 @@ class Simulation:
         return export_ensemble_psrfits(ens, n_obs, out_dir, template,
                                        self.pulsar, **export_kw)
 
+    def run_mc_study(self, priors, n_trials, seed=0, out_dir=None,
+                     mesh=None, study_kw=None, **run_kw):
+        """Run a Monte-Carlo study over this simulation's configuration —
+        the one-call bridge to :mod:`psrsigsim_tpu.mc`.
+
+        ``priors`` is ``{knob: Prior-or-spec-dict}`` (knobs:
+        :data:`psrsigsim_tpu.mc.KNOBS`; e.g. ``{"dm": Uniform(10, 20)}``).
+        Builds a :class:`~psrsigsim_tpu.mc.MonteCarloStudy` via
+        :meth:`MonteCarloStudy.from_simulation` (so
+        :meth:`~psrsigsim_tpu.mc.MonteCarloStudy.export_psrfits` works on
+        it afterwards), runs ``n_trials`` trials, and returns the
+        :class:`~psrsigsim_tpu.mc.StudyResult`.  ``out_dir`` enables the
+        crash-safe journal and the fingerprinted artifact; ``study_kw``
+        passes construction options (``nharm``, ``hist_bins``, ...) and
+        ``run_kw`` passes run options (``chunk_size``, ``resume``,
+        ``telemetry``, ``progress``, ...).
+        """
+        from ..mc import MonteCarloStudy
+
+        study = MonteCarloStudy.from_simulation(
+            self, priors, seed=seed, mesh=mesh, **(study_kw or {}))
+        return study.run(n_trials, out_dir=out_dir, **run_kw)
+
     def save_simulation(self, outfile="simfits", out_format="psrfits",
                         parfile=None, ref_MJD=56000.0, MJD_start=55999.9861):
         """Save simulated data as PSRFITS (template required) or PSRCHIVE

@@ -31,6 +31,10 @@ STAGES = {
     "null_noise": 3,
     "scint": 4,
     "user": 5,
+    # Monte-Carlo study-engine prior draws (psrsigsim_tpu.mc): parameter
+    # sampling lives on its own stage so a trial's prior draws can never
+    # collide with the pipeline's pulse/noise streams for the same key
+    "prior": 6,
 }
 
 

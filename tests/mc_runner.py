@@ -1,0 +1,83 @@
+"""Subprocess driver for Monte-Carlo study kill/resume tests.
+
+The ``mc.kill`` fault point SIGKILLs the sweeping process right after a
+chunk's journal commit, so the pytest process cannot host the faulted
+sweep itself — this script runs as a subprocess, dies mid-sweep when the
+armed fault fires, and is launched again (same out_dir, no plan) to
+prove the journaled study resumes to a byte-identical artifact.
+
+Usage::
+
+    python tests/mc_runner.py OUT_DIR [--plan PLAN_JSON] [--n-trials N]
+        [--chunk-size N] [--seed N]
+
+``PLAN_JSON`` holds ``{"scratch_dir": ..., "spec": {...}}`` for the
+:class:`~psrsigsim_tpu.runtime.faults.FaultPlan`.  The study config is
+fixed (a tiny fold geometry under a dm x noise_scale prior space) so
+every invocation with the same seed sweeps identical trials.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# mirror tests/conftest.py BEFORE jax initializes: unit-test platform is
+# an 8-device virtual CPU so chunk padding matches the pytest process
+os.environ["JAX_PLATFORMS"] = os.environ.get("PSS_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SIM_CONFIG = {
+    "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+    "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+    "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+    "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+    "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+    "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+    "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+}
+PRIORS = {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0},
+          "noise_scale": {"dist": "loguniform", "lo": 0.5, "hi": 2.0}}
+SEED = 3
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--plan", default=None)
+    ap.add_argument("--n-trials", type=int, default=24)
+    ap.add_argument("--chunk-size", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=SEED)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", False)
+
+    from psrsigsim_tpu.mc import MonteCarloStudy
+    from psrsigsim_tpu.runtime import FaultPlan
+    from psrsigsim_tpu.simulate import Simulation
+
+    plan = None
+    if args.plan:
+        with open(args.plan) as f:
+            spec = json.load(f)
+        plan = FaultPlan(spec["scratch_dir"], spec["spec"])
+
+    sim = Simulation(psrdict=SIM_CONFIG)
+    study = MonteCarloStudy.from_simulation(sim, PRIORS, seed=args.seed)
+    res = study.run(args.n_trials, chunk_size=args.chunk_size,
+                    out_dir=args.out_dir, faults=plan)
+    print(json.dumps({"fingerprint": res.fingerprint,
+                      "n_trials": res.n_trials}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -207,6 +207,66 @@ class TestPSRFITS:
         assert S.Nchan == 1
         assert S.dm.value == pytest.approx(13.29, abs=0.5)
 
+    @pytest.mark.parametrize("key,bad,match", [
+        ("NBIN", 0, "NBIN"),
+        ("NBIN", None, "NBIN"),
+        ("NBIN", 512.5, "NBIN"),
+        ("NCHAN", 0, "NCHAN"),
+        ("TSUBINT", -1.0, "TSUBINT"),
+    ])
+    def test_malformed_template_geometry_fails_loudly(self, key, bad,
+                                                      match):
+        """A corrupt/hand-edited template must raise at load with the
+        defective field named — not silently build a signal shell whose
+        sample rate or fold geometry is garbage (the reference's TODO
+        path would propagate whatever the header claims)."""
+        pfit = PSRFITS(path="/tmp/out3.fits", template=TEMPLATE,
+                       obs_mode="PSR")
+        # poison the cached template parameter dict (the sanctioned
+        # injection point: get_signal_params reads through this cache)
+        pfit._make_psrfits_pars_dict()
+        cache = pfit.fits_template.__dict__["_pfit_cache"]
+        cache["PSR"][0][key] = bad
+        with pytest.raises(ValueError, match=match):
+            pfit.make_signal_from_psrfits()
+        # repair the shared cache for other tests using this template
+        del pfit.fits_template.__dict__["_pfit_cache"]
+
+    def test_unknown_obs_mode_raises_not_implemented(self):
+        pfit = PSRFITS(path="/tmp/out4.fits", template=TEMPLATE,
+                       obs_mode="PSR")
+        pfit.get_signal_params()
+        pfit.obs_mode = "CAL"
+        with pytest.raises(NotImplementedError, match="CAL"):
+            pfit._validate_template_geometry()
+
+    def test_search_mode_shell_warns_about_fold_geometry(self, tmp_path):
+        """SEARCH templates reconstruct a fold-geometry shell for
+        reference parity; a direct call must warn so callers know not to
+        trust fold/sublen (PSRFITS.load overrides them)."""
+        import warnings as _warnings
+
+        from psrsigsim_tpu.ism import ISM
+
+        sig = FilterBankSignal(1400.0, 400.0, Nsubband=4,
+                               sample_rate=0.2048, fold=False)
+        psr = Pulsar(0.005, 0.05, GaussProfile(width=0.02),
+                     name="J0000+0000", seed=6)
+        psr.make_pulses(sig, tobs=0.1)
+        ISM().disperse(sig, 12.0)
+        out = str(tmp_path / "s.fits")
+        par = str(tmp_path / "s.par")
+        make_par(sig, psr, outpar=par)
+        sfits = PSRFITS(path=out, template=TEMPLATE, obs_mode="SEARCH")
+        sfits.get_signal_params(signal=sig)
+        sfits.save(sig, psr, parfile=par, verbose=False)
+        loader = PSRFITS(path=out, template=out)
+        assert loader.obs_mode == "SEARCH"
+        with _warnings.catch_warnings(record=True) as rec:
+            _warnings.simplefilter("always")
+            loader.make_signal_from_psrfits()
+        assert any("SEARCH-mode template" in str(w.message) for w in rec)
+
     def test_save_with_real_nanograv_par_strict(self, tmp_path):
         # round 3 flagship: PSRFITS phase connection for a REAL PTA pulsar
         # par (DDK binary, ecliptic astrometry + PM + PX, DMX, FD terms,

@@ -1,0 +1,422 @@
+"""Monte-Carlo study engine (psrsigsim_tpu/mc): priors, trial parity,
+chunk-size invariance, resumable sweeps, results, CLI, dataset export.
+
+The two load-bearing guarantees pinned here:
+
+* trial semantics — a trial whose priors touch only per-observation
+  inputs is bit-identical to ``fold_pipeline`` with the same key, so the
+  study engine measures the SAME observations the ensemble machinery
+  simulates (and can export them, byte-for-byte, through the existing
+  streaming exporter);
+* determinism — merged summary statistics and artifact fingerprints are
+  bit-identical across trial-chunk sizes {32, 128, 512} and across an
+  interrupted-then-resumed sweep (SIGKILL via the ``mc.kill`` fault
+  point, driven through tests/mc_runner.py).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psrsigsim_tpu.mc import (Choice, Fixed, Grid, LogUniform,
+                              MonteCarloStudy, Normal, StudyManifestError,
+                              StudyResult, Uniform, parse_prior)
+from psrsigsim_tpu.simulate import Simulation
+from psrsigsim_tpu.utils.rng import stage_key
+
+SIM_CONFIG = {
+    "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+    "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+    "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+    "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+    "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+    "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+    "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+}
+# a smaller geometry for the 512-trial invariance sweep
+SIM_SMALL = dict(SIM_CONFIG, Nchan=2, sample_rate=0.1024)
+
+TEMPLATE = os.path.join(
+    os.path.dirname(__file__), "..", "data",
+    "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+RUNNER = os.path.join(os.path.dirname(__file__), "mc_runner.py")
+
+
+def _study(priors, seed=3, config=SIM_CONFIG, **kw):
+    return MonteCarloStudy.from_simulation(
+        Simulation(psrdict=dict(config)), priors, seed=seed, **kw)
+
+
+# module-scoped studies: compiled chunk programs are cached per width on
+# the study object, so sharing one instance across tests turns ~10
+# redundant XLA compiles into cache hits (the dominant cost here)
+@pytest.fixture(scope="module")
+def study_dm():
+    return _study({"dm": Uniform(5.0, 20.0)}, seed=3)
+
+
+@pytest.fixture(scope="module")
+def study_dm_ns():
+    return _study({"dm": Uniform(5.0, 20.0),
+                   "noise_scale": LogUniform(0.5, 2.0)}, seed=3)
+
+
+class TestPriors:
+    def test_sampling_is_key_deterministic(self):
+        key = jax.random.key(0)
+        for prior in (Uniform(2.0, 5.0), LogUniform(0.1, 10.0),
+                      Normal(1.0, 0.2), Choice((1.0, 2.0, 3.0))):
+            a = float(prior.sample(key, 0))
+            b = float(prior.sample(key, 0))
+            assert a == b
+            lo, hi = prior.support()
+            assert lo < hi
+
+    def test_uniform_and_loguniform_stay_in_support(self):
+        keys = jax.vmap(jax.random.key)(np.arange(256))
+        u = jax.vmap(lambda k: Uniform(2.0, 5.0).sample(k, 0))(keys)
+        lg = jax.vmap(lambda k: LogUniform(0.1, 10.0).sample(k, 0))(keys)
+        assert float(u.min()) >= 2.0 and float(u.max()) < 5.0
+        assert float(lg.min()) >= 0.1 and float(lg.max()) < 10.0
+
+    def test_grid_cycles_by_trial_index(self):
+        g = Grid((1.0, 2.0, 3.0))
+        key = jax.random.key(0)
+        vals = [float(g.sample(key, i)) for i in range(6)]
+        assert vals == [1.0, 2.0, 3.0, 1.0, 2.0, 3.0]
+
+    def test_parse_prior_roundtrip_and_validation(self):
+        for prior in (Fixed(3.0), Uniform(0.0, 1.0), LogUniform(0.5, 2.0),
+                      Normal(0.0, 1.0), Grid((1.0, 2.0)),
+                      Choice((1.0, 2.0), (0.25, 0.75))):
+            back = parse_prior(prior.describe())
+            assert back == prior
+        with pytest.raises(ValueError):
+            parse_prior({"dist": "nope"})
+        with pytest.raises(ValueError):
+            parse_prior({"dist": "uniform", "lo": 1.0})  # missing hi
+        with pytest.raises(ValueError):
+            Uniform(2.0, 2.0)
+        with pytest.raises(ValueError):
+            LogUniform(0.0, 1.0)
+        with pytest.raises(ValueError):
+            Choice((1.0,), (0.5, 0.5))
+
+    def test_unknown_knob_rejected(self):
+        with pytest.raises(ValueError, match="unknown study knob"):
+            _study({"bogus_knob": Uniform(0.0, 1.0)})
+
+    def test_exact_fft_config_rejected(self, study_dm):
+        """The trial program implements the envelope branch only; an
+        exact-FFT config must be refused loudly, never silently measured
+        with different data than run()/export would simulate."""
+        import dataclasses
+
+        cfg_fft = dataclasses.replace(study_dm.cfg, shift_mode="fft")
+        with pytest.raises(ValueError, match="envelope"):
+            MonteCarloStudy(cfg_fft, study_dm._profiles_np,
+                            study_dm.noise_norm, {"dm": Uniform(5.0, 20.0)})
+
+
+class TestTrialSemantics:
+    def test_trial_block_matches_fold_pipeline_bitwise(self):
+        """dm/noise_scale priors only => the trial body IS the fold
+        pipeline: same stage keys, same sampler entry points, bit-equal
+        output under jit."""
+        from psrsigsim_tpu.simulate.pipeline import fold_pipeline
+
+        study = _study({"dm": Fixed(12.5)}, seed=7)
+        cfg = study.cfg
+        key = stage_key(jax.random.key(7), "user", 3)
+        freqs = jnp.asarray(cfg.meta.dat_freq_mhz(), jnp.float32)
+        chan_ids = jnp.arange(cfg.meta.nchan)
+        prof = jnp.asarray(study._profiles_np)
+
+        @jax.jit
+        def trial(k):
+            return study._trial_block(k, jnp.int32(3), prof, freqs,
+                                      chan_ids)[0]
+
+        ref = fold_pipeline(key, jnp.float32(12.5),
+                            jnp.float32(study.noise_norm), prof, cfg,
+                            freqs=freqs, chan_ids=chan_ids)
+        assert np.array_equal(np.asarray(trial(key)), np.asarray(ref))
+
+    def test_sampled_params_match_metric_columns(self, study_dm_ns):
+        """The host-side parameter table is the SAME in-graph sampling
+        the trial program runs — per-trial param columns agree exactly."""
+        study = study_dm_ns
+        res = study.run(24, chunk_size=8)
+        params = study.sampled_params(24)
+        assert np.array_equal(params, res.metrics[:, :2])
+
+    def test_width_amp_and_nulling_knobs_run(self):
+        study = _study({"width": Uniform(0.02, 0.08),
+                        "amp": LogUniform(0.5, 2.0),
+                        "tau_d_ms": LogUniform(1e-4, 1e-2),
+                        "null_frac": Fixed(0.5)})
+        res = study.run(8, chunk_size=8)
+        assert res.metrics.shape == (8, 4 + 4)
+        assert np.isfinite(res.metrics).all()
+
+    def test_metrics_are_physical(self):
+        """Residuals scatter around zero within the reported sigma; the
+        reported sigma tracks the noise scale."""
+        study = _study({"noise_scale": Grid((0.5, 2.0))})
+        res = study.run(32, chunk_size=16)
+        err = res.column("toa_err")
+        sig = res.column("toa_sigma")
+        assert abs(err.mean()) < 4 * sig.mean() / np.sqrt(err.size)
+        ns = res.column("noise_scale")
+        assert sig[ns > 1.0].mean() > sig[ns < 1.0].mean()
+
+
+class TestChunkInvariance:
+    def test_bit_identical_across_chunk_sizes_32_128_512(self, tmp_path):
+        """The acceptance invariance: {32, 128, 512} trial chunks yield
+        bit-identical merged summary statistics AND artifact
+        fingerprints (also gated platform-side by `make bench-mc`)."""
+        study = _study({"dm": Uniform(5.0, 20.0),
+                        "noise_scale": LogUniform(0.5, 2.0)},
+                       config=SIM_SMALL, seed=5)
+        outs = []
+        for cs in (32, 128, 512):
+            res = study.run(512, chunk_size=cs,
+                            out_dir=str(tmp_path / f"c{cs}"))
+            outs.append((json.dumps(res.summary(), sort_keys=True),
+                         res.fingerprint, res.metrics, res.hist))
+        for summary, fp, metrics, hist in outs[1:]:
+            assert summary == outs[0][0]
+            assert fp == outs[0][1]
+            assert np.array_equal(metrics, outs[0][2])
+            assert np.array_equal(hist, outs[0][3])
+        # counts conserved: every trial in every histogram
+        assert (outs[0][3].sum(axis=1) == 512).all()
+
+
+class TestResumeAndArtifact:
+    def test_interrupt_resume_byte_identical(self, tmp_path, study_dm):
+        study = study_dm
+        full = study.run(40, chunk_size=16, out_dir=str(tmp_path / "a"))
+        assert study.run(40, chunk_size=16, out_dir=str(tmp_path / "b"),
+                         _stop_after_chunks=1) is None
+        resumed = study.run(40, chunk_size=16, out_dir=str(tmp_path / "b"))
+        assert resumed.fingerprint == full.fingerprint
+        for name in ("study_result.json", "trials.npy"):
+            a = (tmp_path / "a" / name).read_bytes()
+            b = (tmp_path / "b" / name).read_bytes()
+            assert a == b, f"{name} differs after resume"
+
+    def test_resume_across_different_chunk_sizes(self, tmp_path,
+                                                  study_dm):
+        study = study_dm
+        full = study.run(40, chunk_size=16, out_dir=str(tmp_path / "a"))
+        study.run(40, chunk_size=8, out_dir=str(tmp_path / "c"),
+                  _stop_after_chunks=2)
+        resumed = study.run(40, chunk_size=16, out_dir=str(tmp_path / "c"))
+        assert resumed.fingerprint == full.fingerprint
+
+    def test_torn_journal_tail_is_survived(self, tmp_path, study_dm):
+        study = study_dm
+        full = study.run(40, chunk_size=16, out_dir=str(tmp_path / "a"))
+        out = str(tmp_path / "d")
+        study.run(40, chunk_size=16, out_dir=out, _stop_after_chunks=1)
+        with open(os.path.join(out, "mc_journal.jsonl"), "a") as f:
+            f.write('{"e": "chunk", "start": 16, "cou')  # torn mid-write
+        resumed = study.run(40, chunk_size=16, out_dir=out)
+        assert resumed.fingerprint == full.fingerprint
+
+    def test_manifest_guards_against_different_study(self, tmp_path,
+                                                     study_dm):
+        out = str(tmp_path / "a")
+        study_dm.run(16, chunk_size=8, out_dir=out)
+        with pytest.raises(StudyManifestError, match="seed"):
+            _study({"dm": Uniform(5.0, 20.0)}, seed=4).run(
+                16, chunk_size=8, out_dir=out)
+        with pytest.raises(StudyManifestError, match="priors"):
+            _study({"dm": Uniform(5.0, 21.0)}, seed=3).run(
+                16, chunk_size=8, out_dir=out)
+
+    def test_result_load_roundtrip_and_queries(self, tmp_path, study_dm):
+        study = study_dm
+        res = study.run(40, chunk_size=16, out_dir=str(tmp_path / "a"))
+        back = StudyResult.load(str(tmp_path / "a"))
+        assert back.fingerprint == res.fingerprint
+        assert np.array_equal(back.metrics, res.metrics)
+        # queries: percentile/ecdf/conditional consistency
+        med = res.percentile("toa_err", 50)
+        vals, cdf = res.ecdf("toa_err")
+        assert vals[0] <= med <= vals[-1]
+        assert cdf[-1] == 1.0
+        cond = res.conditional("dm", "toa_sigma", bins=4)
+        assert cond["count"].sum() == 40
+        # histogram counts conserved and edges consistent
+        assert res.hist.sum(axis=1).max() <= 40
+        edges = res.hist_edges("dm")
+        lo, hi = res.hist_ranges["dm"]
+        assert edges[0] == lo and edges[-1] == hi
+
+    def test_telemetry_lands_on_manifest(self, tmp_path, study_dm):
+        from psrsigsim_tpu.runtime import StageTimers
+
+        tel = StageTimers(extra_stages=("reduce",))
+        study = study_dm
+        study.run(16, chunk_size=8, out_dir=str(tmp_path / "a"),
+                  telemetry=tel)
+        with open(tmp_path / "a" / "study_manifest.json") as f:
+            man = json.load(f)
+        for stage in ("dispatch", "fetch", "reduce", "write"):
+            assert man["pipeline"][f"{stage}_calls"] > 0
+        assert man["artifact_sha256"]
+
+
+class TestBridges:
+    def test_ensemble_to_mc_study(self, study_dm):
+        sim = Simulation(psrdict=dict(SIM_CONFIG))
+        ens = sim.to_ensemble()
+        study = ens.to_mc_study({"dm": Uniform(5.0, 20.0)}, seed=3)
+        direct = study_dm
+        a = study.run(8, chunk_size=8)
+        b = direct.run(8, chunk_size=8)
+        assert np.array_equal(a.metrics, b.metrics)
+
+    def test_simulation_run_mc_study(self, tmp_path):
+        sim = Simulation(psrdict=dict(SIM_CONFIG))
+        res = sim.run_mc_study({"dm": Uniform(5.0, 20.0)}, 16, seed=3,
+                               out_dir=str(tmp_path / "a"), chunk_size=8)
+        assert res.n_trials == 16 and res.fingerprint
+
+    def test_export_psrfits_matches_direct_ensemble_export(self, tmp_path,
+                                                           study_dm_ns):
+        """Dataset generation: a dm+noise_scale study's PSRFITS export is
+        byte-identical to exporting the ensemble with the sampled DMs and
+        float32-exact noise norms — the trials ARE the observations."""
+        from psrsigsim_tpu.io import export_ensemble_psrfits
+
+        study = study_dm_ns
+        d1, d2 = str(tmp_path / "study"), str(tmp_path / "direct")
+        paths1 = study.export_psrfits(4, d1, TEMPLATE, supervised=False,
+                                      writers=1, chunk_size=2)
+        params = study.sampled_params(4)
+        dms = np.asarray(params[:, 0], np.float64)
+        # the exporter must form the per-obs norm in float32 exactly as
+        # the in-graph trial does (f32 base * f32 scale)
+        norms = np.asarray(np.float32(study.noise_norm) * params[:, 1],
+                           np.float64)
+        ens = Simulation(psrdict=dict(SIM_CONFIG)).to_ensemble()
+        paths2 = export_ensemble_psrfits(ens, 4, d2, TEMPLATE, ens.pulsar,
+                                         seed=3, dms=dms, noise_norms=norms,
+                                         writers=1, chunk_size=2)
+        for a, b in zip(sorted(paths1), sorted(paths2)):
+            assert open(a, "rb").read() == open(b, "rb").read()
+        with open(os.path.join(d1, "export_manifest.json")) as f:
+            man = json.load(f)
+        assert "mc_study" in man  # provenance stamp
+
+    def test_export_psrfits_rejects_profile_priors(self, tmp_path):
+        study = _study({"width": Uniform(0.02, 0.08)})
+        with pytest.raises(NotImplementedError, match="width"):
+            study.export_psrfits(2, str(tmp_path / "x"), TEMPLATE)
+
+
+class TestCLI:
+    def test_toml_min_parser(self):
+        from psrsigsim_tpu.mc.__main__ import parse_toml_min
+
+        spec = parse_toml_min(
+            '# comment\n[a]\nx = 1\ny = 2.5\nz = "s"\nflag = true\n'
+            'arr = [1.0, 2.0]  # trailing\n[b.c]\nk = -3\n')
+        assert spec == {"a": {"x": 1, "y": 2.5, "z": "s", "flag": True,
+                              "arr": [1.0, 2.0]}, "b": {"c": {"k": -3}}}
+        with pytest.raises(ValueError):
+            parse_toml_min("[[array.of.tables]]\n")
+        with pytest.raises(ValueError):
+            parse_toml_min("key value\n")
+
+    def test_cli_runs_a_spec(self, tmp_path, capsys):
+        from psrsigsim_tpu.mc.__main__ import main
+
+        spec_path = str(tmp_path / "study.toml")
+        out_dir = str(tmp_path / "out")
+        lines = ["[simulation]"]
+        for k, v in SIM_CONFIG.items():
+            if isinstance(v, str):
+                lines.append(f'{k} = "{v}"')
+            elif isinstance(v, bool):
+                lines.append(f"{k} = {str(v).lower()}")
+            elif isinstance(v, list):
+                lines.append(f"{k} = {v}")
+            else:
+                lines.append(f"{k} = {v}")
+        lines += ["[study]", "n_trials = 16", "seed = 2",
+                  "chunk_size = 8", f'out_dir = "{out_dir}"',
+                  "[priors.dm]", 'dist = "uniform"', "lo = 8.0",
+                  "hi = 16.0"]
+        with open(spec_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        rc = main([spec_path, "--quiet"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["metric"] == "mc_study" and out["n_trials"] == 16
+        assert out["artifact_sha256"]
+        assert os.path.exists(os.path.join(out_dir, "study_result.json"))
+
+
+@pytest.mark.faults
+class TestKillResume:
+    @pytest.fixture(autouse=True)
+    def _bind_study(self, study_dm_ns):
+        self.study = study_dm_ns
+
+    def test_sigkill_mid_sweep_resumes_byte_identical(self, tmp_path):
+        """mc.kill fires right after the first chunk's journal commit:
+        the sweep dies with SIGKILL; the resume run completes it and the
+        artifact matches an uninterrupted run byte for byte."""
+        # the clean reference run executes in-process — the runner's study
+        # config IS the shared study_dm_ns fixture (same psrdict, priors,
+        # seed; asserted below so the two can never drift apart).  Only
+        # the kill and the resume need real subprocesses, since mc.kill
+        # SIGKILLs its host.
+        import mc_runner
+
+        study = self.study  # set by the fixture below
+        assert mc_runner.SIM_CONFIG == SIM_CONFIG
+        assert {k: parse_prior(v) for k, v in mc_runner.PRIORS.items()} \
+            == study.priors
+        assert mc_runner.SEED == study.seed
+        clean = str(tmp_path / "clean")
+        clean_res = study.run(24, chunk_size=8, out_dir=clean)
+        clean_fp = {"fingerprint": clean_res.fingerprint}
+
+        plan_file = str(tmp_path / "plan.json")
+        with open(plan_file, "w") as f:
+            json.dump({"scratch_dir": str(tmp_path / "scratch"),
+                       "spec": {"mc.kill": {"after_start": 0}}}, f)
+        killed = str(tmp_path / "killed")
+        proc = subprocess.run(
+            [sys.executable, RUNNER, killed, "--plan", plan_file],
+            capture_output=True, text=True, timeout=540)
+        assert proc.returncode in (-9, 137), (
+            f"expected SIGKILL, got rc={proc.returncode}\n{proc.stderr}")
+        # the journal committed chunk 0 before dying
+        assert os.path.exists(os.path.join(killed, "mc_journal.jsonl"))
+        assert not glob.glob(os.path.join(killed, "study_result.json"))
+
+        proc = subprocess.run(
+            [sys.executable, RUNNER, killed, "--plan", plan_file],
+            capture_output=True, text=True, timeout=540)
+        assert proc.returncode == 0, proc.stderr
+        resumed = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert resumed["fingerprint"] == clean_fp["fingerprint"]
+        for name in ("study_result.json", "trials.npy"):
+            a = open(os.path.join(clean, name), "rb").read()
+            b = open(os.path.join(killed, name), "rb").read()
+            assert a == b, f"{name} differs after SIGKILL+resume"

@@ -80,6 +80,35 @@ class TestFormatPdv:
         assert native.format_pdv_block(row, 0, 0).decode() == self._py(row, 0, 0)
 
 
+class TestEncodeGate:
+    """The BENCH_r05 regression gate: a native encode the bench measures
+    clearly faster must also be the path encode_preferred selects."""
+
+    def test_gate_raises_on_unselected_big_win(self):
+        with pytest.raises(RuntimeError, match="selection regressed"):
+            native.encode_gate_check(4.17, selected=False)
+
+    def test_gate_passes_consistent_states(self):
+        assert native.encode_gate_check(4.17, selected=True)
+        assert native.encode_gate_check(1.5, selected=False)
+        assert native.encode_gate_check(1.5, selected=True)
+        # exactly at threshold: not "exceeds", no flap on a 2.0x host
+        assert native.encode_gate_check(2.0, selected=False)
+
+    def test_probe_decision_consistent_with_gate(self):
+        """encode_preferred's own cached verdicts can never trip the gate:
+        a bucket it marked un-preferred had measured t_nat >= 0.9*t_np,
+        i.e. speedup <= 1/0.9 < 2x."""
+        if not native.encode_available():
+            pytest.skip("native library unavailable on this host")
+        selected = native.encode_preferred(1 << 21)
+        for bucket, ok in native.encode_speed_probe().items():
+            assert isinstance(ok, bool)
+        # whatever the probe decided, it is self-consistent with the
+        # 0.9 margin, so the gate only fires on probe/reality drift
+        assert native.encode_gate_check(1.0 / 0.9, selected or False)
+
+
 class TestIntegration:
     """Files written with the native path enabled match the fallbacks."""
 
