@@ -9,8 +9,12 @@
 # merged statistics + artifact fingerprints at trial-chunk sizes
 # {32,128,512}, interrupted-sweep resume identity, stage timers present
 # (bench.py mc_smoke).
+# `make serve-smoke` is the serving-layer gate: batching invariance
+# across bucket widths {1,8,32}, cache hits with zero device calls,
+# one compile per (geometry, width), clean drain, batched-vs-serial
+# throughput + latency percentiles (bench.py serve_smoke).
 
-.PHONY: lint test test-faults bench-export bench-mc
+.PHONY: lint test test-faults bench-export bench-mc serve-smoke
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -26,3 +30,6 @@ bench-export:
 
 bench-mc:
 	JAX_PLATFORMS=cpu python bench.py --mc-smoke
+
+serve-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve-smoke

@@ -1319,6 +1319,174 @@ def mc_smoke():
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 7: simulation serving layer (psrsigsim_tpu/serve)
+# ---------------------------------------------------------------------------
+
+# the serving bench geometry: small enough that CPU CI turns batches
+# around quickly, structured like the export-bench fold config
+_SERVE_BASE_SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05, "seed": 0, "dm": 10.0,
+}
+
+
+def _serve_spec(i):
+    return dict(_SERVE_BASE_SPEC, seed=1000 + i, dm=10.0 + 0.1 * i)
+
+
+def time_serve(n_requests=None, n_serial=8):
+    """Config 7: serving-layer throughput — dynamically batched requests
+    per second vs a serial one-request-at-a-time baseline (the same
+    programs, width-1 buckets, no coalescing), plus request-latency
+    percentiles from the engine's bounded histograms and the cache-hit
+    service rate.
+
+    Dispatch overhead is the whole story on relay platforms (~0.5 s per
+    device call, BENCH_r04): the batcher turns N requests into N/width
+    device calls, so the batched/serial ratio approaches the bucket
+    width there, while on a local CPU it measures the engine's own
+    overhead floor."""
+    import shutil
+    import tempfile
+
+    from psrsigsim_tpu.serve import SimulationService
+
+    if n_requests is None:
+        n_requests = int(os.environ.get("PSS_BENCH_SERVE_REQUESTS", "64"))
+    specs = [_serve_spec(i) for i in range(n_requests)]
+
+    # serial baseline: width-1 buckets, no coalescing window, submit ->
+    # wait -> submit (one device call per request by construction)
+    svc = SimulationService(cache_dir=None, widths=(1,), batch_window_s=0.0)
+    svc.warmup(_SERVE_BASE_SPEC)
+    rid, _ = svc.submit(_serve_spec(10_000))   # warm the serving path
+    svc.result(rid, timeout=600)
+    t0 = time.perf_counter()
+    for spec in specs[:n_serial]:
+        rid, _ = svc.submit(spec)
+        svc.result(rid, timeout=600)
+    t_serial = (time.perf_counter() - t0) / n_serial
+    svc.close()
+
+    # dynamic batching: all requests submitted concurrently, coalesced
+    # into width buckets, results collected after
+    cache_dir = tempfile.mkdtemp(prefix="pss_serve_bench_")
+    try:
+        svc = SimulationService(cache_dir=cache_dir, widths=(1, 8, 32),
+                                batch_window_s=0.01, max_queue=n_requests)
+        svc.warmup(_SERVE_BASE_SPEC)
+        rid, _ = svc.submit(_serve_spec(10_001))
+        svc.result(rid, timeout=600)
+        t0 = time.perf_counter()
+        ids = [svc.submit(spec)[0] for spec in specs]
+        for rid in ids:
+            svc.result(rid, timeout=600)
+        t_batched = (time.perf_counter() - t0) / n_requests
+        device_calls = svc.registry.device_calls
+        bucket_calls = {f"w{w}": c
+                        for (_, w), c in svc.registry.call_counts().items()}
+        snap = svc.timers.snapshot()
+        drained = svc.close()
+
+        # cache-hit service rate: a FRESH service over the same cache
+        # dir (the restart path) so every hit exercises the on-disk
+        # content-addressed cache — in-process resubmits would be
+        # answered by the in-memory request table instead and never
+        # touch ResultCache at all
+        svc = SimulationService(cache_dir=cache_dir, widths=(1, 8, 32),
+                                batch_window_s=0.01, max_queue=n_requests)
+        t0 = time.perf_counter()
+        for spec in specs:
+            rid, _ = svc.submit(spec)
+            svc.result(rid, timeout=600)
+        t_cache = (time.perf_counter() - t0) / n_requests
+        cache_calls = svc.registry.device_calls
+        cache_hits = svc.cache_hits
+        drained = drained and svc.close()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    return {
+        "n_requests": n_requests,
+        "n_serial_baseline": n_serial,
+        "widths": [1, 8, 32],
+        "serial_req_per_sec": round(1.0 / t_serial, 2),
+        "batched_req_per_sec": round(1.0 / t_batched, 2),
+        "batched_over_serial": round(t_serial / t_batched, 2),
+        "cache_hit_req_per_sec": round(1.0 / t_cache, 2),
+        "cache_hit_device_calls": cache_calls,     # must be 0
+        "cache_hits": cache_hits,                  # must be n_requests
+        "device_calls": device_calls,
+        "bucket_calls": bucket_calls,
+        "request_p50_s": snap.get("request_p50_s", 0.0),
+        "request_p95_s": snap.get("request_p95_s", 0.0),
+        "request_p99_s": snap.get("request_p99_s", 0.0),
+        "drained": drained,
+        "bottleneck_stage": snap["bottleneck"],
+    }
+
+
+def serve_smoke():
+    """Quick serving-layer gate (``make serve-smoke``): a small request
+    stream must (a) serve BIT-identical results for the same spec solo,
+    coalesced with strangers, and across bucket widths {1,8,32} (the
+    acceptance invariance), (b) serve repeated identical requests from
+    the result cache with ZERO device calls, (c) compile exactly once
+    per (geometry, width) — the retrace guard, (d) drain cleanly, and
+    (e) beat — or at minimum not collapse against — the serial
+    one-request-at-a-time baseline while reporting latency percentiles.
+    Runs on whatever platform jax has (CPU in CI); asserts invariants,
+    not absolute rates."""
+    from psrsigsim_tpu.serve import SimulationService
+
+    target = _SERVE_BASE_SPEC
+
+    def serve_target(widths, n_strangers, window):
+        svc = SimulationService(cache_dir=None, widths=widths,
+                                batch_window_s=window)
+        try:
+            svc.warmup(target)
+            ids = [svc.submit(_serve_spec(i))[0] for i in range(n_strangers)]
+            rid, _ = svc.submit(target)
+            out = svc.result(rid, timeout=600)
+            for i in ids:
+                svc.result(i, timeout=600)
+            svc.registry.assert_single_compile()      # (c) retrace gate
+            widths_used = {w for (_, w) in svc.registry.call_counts()}
+            return np.asarray(out).tobytes(), widths_used
+        finally:
+            assert svc.close(), "serving engine failed to drain"   # (d)
+
+    solo, w1 = serve_target((1,), 0, 0.0)
+    co8, w8 = serve_target((8,), 6, 0.1)
+    co32, w32 = serve_target((32,), 20, 0.1)
+    assert 1 in w1 and 8 in w8 and 32 in w32, (w1, w8, w32)
+    assert solo == co8 == co32, (
+        "served result is NOT batching-invariant: bytes differ between "
+        "solo/coalesced/bucket-width executions")           # (a)
+
+    result = time_serve(
+        n_requests=int(os.environ.get("PSS_BENCH_SERVE_REQUESTS", "24")),
+        n_serial=6)
+    assert result["cache_hit_device_calls"] == 0, (
+        "cache hits re-executed on device")                 # (b)
+    assert result["cache_hits"] == result["n_requests"], (
+        "resubmits were not served from the on-disk result cache")
+    assert result["drained"], "serving engine failed to drain"
+    # (e) batched-vs-serial is REPORTED, not required to win here: on a
+    # local CPU there is no per-dispatch fixed cost to amortize, so a
+    # coalesced batch pays window latency + pad waste against a serial
+    # baseline that pays nothing (measured ~0.3x at this geometry); on
+    # the relay platforms this repo benches (0.5 s/dispatch, BENCH_r04)
+    # the ratio approaches the bucket width.  The floor only catches an
+    # engine that COLLAPSED (deadlocked batcher, per-request retraces)
+    assert result["batched_over_serial"] > 0.05, result
+
+    return {"metric": "serve_smoke", "invariant": True, **result, "ok": True}
+
+
 def time_io_encode(nchan=2048, nsub=20, nbin=2048):
     """Host-side PSRFITS subint encode (float32 -> '>i2' relayout) and pdv
     text formatting: C++ fast path vs the pure-Python fallback."""
@@ -1380,25 +1548,122 @@ def time_io_encode(nchan=2048, nsub=20, nbin=2048):
 
 _REAL_STDOUT = sys.stdout
 
+# ---------------------------------------------------------------------------
+# The citable record (VERDICT r5 fix)
+# ---------------------------------------------------------------------------
+# The driver stores only the last ~2000 characters of stdout, and round
+# 5's full-detail result line outgrew that window: the captured tail
+# began mid-config-2 and config 1 and config 4 had NO driver numbers of
+# record.  The record is now two artifacts: (a) the FULL detail dict,
+# written atomically (temp + fsync + rename) to bench_full.json after
+# every completed config, and (b) a COMPACT summary line — headline
+# fields only, short keys, budgeted under SUMMARY_BUDGET chars with a
+# hard assertion — printed after every config and again (non-provisional)
+# as the final line, so whatever the driver's tail captures contains
+# EVERY config's speedup.  The summary is built by iterating the detail
+# dict itself, so a measured config physically cannot be dropped from
+# the emitted JSON (and _assert_summary_complete re-checks, loudly).
 
-def _checkpoint(detail):
-    """Print a PROVISIONAL result line after each completed config.
+SUMMARY_BUDGET = 1800
+DETAIL_PATH = os.path.join(REPO, "bench_full.json")
 
-    The driver records the LAST stdout line; the full bench is ~10-15
-    minutes of mostly compiles, so if the process is killed mid-run the
-    most recent provisional line still preserves every config measured
-    so far (the final line overwrites it with the complete result).
-    """
+# (detail key, compact key, round digits or None to pass through)
+_COMPACT_FIELDS = (
+    ("speedup", "spd", 1),
+    ("packed_speedup", "pspd", 1),
+    ("machinery_speedup", "mspd", 0),
+    ("tpu_obs_per_sec", "obs_s", 1),
+    ("tpu_trials_per_sec", "trl_s", 1),
+    ("e2e_packed_obs_per_sec", "pobs_s", 1),
+    ("batched_req_per_sec", "req_s", 1),
+    ("serial_req_per_sec", "sreq_s", 1),
+    ("request_p99_s", "p99_s", 4),
+    ("cache_hit_req_per_sec", "hit_s", 1),
+    ("subint_encode_speedup", "enc_spd", 1),
+    ("native_encode_selected", "enc_sel", None),
+    ("bottleneck_stage", "bn", None),
+    ("slope_ok", "ok", None),
+    ("sync_warn", "warn", None),
+)
+
+
+def _write_detail_atomic(detail, path=DETAIL_PATH):
+    """Crash-safe full record: temp + fsync + rename, so the file is
+    always a complete parseable JSON document — never a truncated tail."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(detail, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _compact_config(d):
+    """Headline fields of one config's detail dict, short keys, rounded."""
+    out = {}
+    for key, short, digits in _COMPACT_FIELDS:
+        if key not in d:
+            continue
+        val = d[key]
+        if digits is not None and isinstance(val, (int, float)):
+            val = round(float(val), digits)
+        out[short] = val
+    return out
+
+
+def _summary_line(detail, provisional=False):
+    """The compact machine-parseable summary: every dict-valued config in
+    ``detail`` appears under ``cfg`` (completeness by construction), the
+    headline metric stays at the top level, and the serialized line is
+    asserted under SUMMARY_BUDGET so the driver's tail capture can never
+    again truncate the citable record."""
     ens = detail.get("config5_ensemble", {})
     line = {
         "metric": "fold_ensemble_obs_per_sec",
         "value": ens.get("tpu_obs_per_sec", 0.0),
         "unit": "obs/s",
         "vs_baseline": ens.get("speedup", 0.0),
-        "provisional": True,
-        "detail": detail,
+        "detail_file": os.path.basename(DETAIL_PATH),
+        "cfg": {name: _compact_config(d)
+                for name, d in detail.items() if isinstance(d, dict)},
     }
-    print(json.dumps(line), file=_REAL_STDOUT, flush=True)
+    if provisional:
+        line["provisional"] = True
+    _assert_summary_complete(detail, line)
+    encoded = json.dumps(line, separators=(",", ":"))
+    if len(encoded) > SUMMARY_BUDGET:
+        raise RuntimeError(
+            f"bench summary line is {len(encoded)} chars "
+            f"(> {SUMMARY_BUDGET}): the citable record would truncate in "
+            "the driver's tail capture — trim _COMPACT_FIELDS")
+    return encoded
+
+
+def _assert_summary_complete(detail, line):
+    """A bench run that measured a config MUST have it in the emitted
+    JSON — a silently dropped config is a broken record, so fail the run
+    instead (VERDICT r5: config1/config4 vanished from the r05 record)."""
+    measured = {name for name, d in detail.items() if isinstance(d, dict)}
+    emitted = set(line.get("cfg", {}))
+    missing = sorted(measured - emitted)
+    if missing:
+        raise RuntimeError(
+            f"bench record incomplete: measured config(s) {missing} absent "
+            "from the emitted summary JSON")
+
+
+def _checkpoint(detail):
+    """After each completed config: persist the full detail atomically
+    and print a PROVISIONAL compact summary line.
+
+    The driver records the LAST stdout line; the full bench is ~10-15
+    minutes of mostly compiles, so if the process is killed mid-run the
+    most recent provisional line still preserves every config measured
+    so far — and stays small enough that the tail capture holds ALL of
+    it (the final line overwrites it with the complete result)."""
+    _write_detail_atomic(detail)
+    print(_summary_line(detail, provisional=True), file=_REAL_STDOUT,
+          flush=True)
 
 
 def main():
@@ -1416,9 +1681,20 @@ def main():
             result = mc_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
+    if "--serve-smoke" in sys.argv[1:]:
+        # `make serve-smoke`: batching invariance + cache-hit no-device
+        # + drain + retrace gates, with latency percentiles reported
+        with contextlib.redirect_stdout(sys.stderr):
+            result = serve_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
     with contextlib.redirect_stdout(sys.stderr):
-        result = _main()
-    print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        detail = _main()
+    # the citable record: full detail atomically on disk, compact
+    # complete summary as the final stdout line (see the block above
+    # _checkpoint — VERDICT r5's truncated-record fix)
+    _write_detail_atomic(detail)
+    print(_summary_line(detail), file=_REAL_STDOUT, flush=True)
 
 
 def _main():
@@ -1560,6 +1836,16 @@ def _main():
         f"{mc['speedup']:.1f}x (bottleneck: {mc['bottleneck_stage']})")
     _checkpoint(detail)
 
+    # --- config 7: simulation serving layer -----------------------------
+    srv = time_serve()
+    detail["config7_serve"] = srv
+    log(f"config7_serve: batched {srv['batched_req_per_sec']:.1f} req/s vs "
+        f"serial {srv['serial_req_per_sec']:.1f} req/s "
+        f"({srv['batched_over_serial']:.2f}x; cache hits "
+        f"{srv['cache_hit_req_per_sec']:.1f} req/s, p99 "
+        f"{srv['request_p99_s']*1e3:.1f} ms, buckets {srv['bucket_calls']})")
+    _checkpoint(detail)
+
     # --- end-to-end export: device -> host -> PSRFITS files -------------
     exp = time_export_e2e()
     detail["export_e2e"] = exp
@@ -1583,13 +1869,7 @@ def _main():
     log(f"io_encode: native {detail['io_encode']}")
     detail["total_bench_s"] = round(time.perf_counter() - t_start, 1)
 
-    return {
-        "metric": "fold_ensemble_obs_per_sec",
-        "value": round(obs_per_sec, 2),
-        "unit": "obs/s",
-        "vs_baseline": round(speedup, 2),
-        "detail": detail,
-    }
+    return detail
 
 
 if __name__ == "__main__":

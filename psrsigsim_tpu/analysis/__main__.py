@@ -112,12 +112,14 @@ def main(argv=None):
         print("psrlint: clean")
 
     if args.trace_check:
-        from .trace_check import run_trace_check
+        from .trace_check import run_serve_trace_check, run_trace_check
 
         results = run_trace_check()
         ok = sum(1 for r in results if r.status == "ok")
         exempt = sum(1 for r in results if r.status == "exempt")
-        print(f"trace-check: {ok} ops traced clean, {exempt} exempt")
+        serve_ok = len(run_serve_trace_check())
+        print(f"trace-check: {ok} ops traced clean, {exempt} exempt, "
+              f"{serve_ok} serving bucket program(s) traced clean")
 
     return status
 

@@ -18,7 +18,8 @@ fail here regardless of what the linter thought.  Runs on CPU
 
 from __future__ import annotations
 
-__all__ = ["EXEMPT", "probe_specs", "run_trace_check", "ProbeResult"]
+__all__ = ["EXEMPT", "probe_specs", "run_trace_check",
+           "run_serve_trace_check", "ProbeResult"]
 
 from dataclasses import dataclass
 
@@ -130,6 +131,38 @@ def _check_one(name, fn, args):
             f"{name}: traced {traces[0]} times for one call signature — "
             "something in it depends on concrete values or fresh Python "
             "identity per call")
+
+
+def run_serve_trace_check(widths=(1, 8)):
+    """Probe the serving layer's width-bucketed batch programs
+    (:func:`psrsigsim_tpu.parallel.build_width_bucket_fn` over a
+    canonical tiny geometry): ``make_jaxpr`` + ``eval_shape`` + a stable
+    jit cache (retrace count == 1) at each probed bucket width — the
+    dynamic twin of the serving registry's AOT single-compile guard,
+    run where the linter gate runs so a trace-unsafe edit to the fold
+    core or the batch wrapper fails CI before it reaches a server.
+    """
+    import numpy as np
+
+    import jax
+
+    from ..parallel.ensemble import build_width_bucket_fn
+    from ..serve.spec import build_geometry, canonicalize
+
+    canonical = canonicalize({
+        "nchan": 2, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+        "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+        "period_s": 0.005, "smean_jy": 0.05, "seed": 0, "dm": 10.0,
+    })
+    cfg, profiles, _ = build_geometry(canonical)
+    fn = build_width_bucket_fn(cfg, profiles)
+    results = []
+    for w in widths:
+        keys = jax.vmap(jax.random.key)(np.arange(w, dtype=np.uint32))
+        z = np.zeros(w, np.float32)
+        _check_one(f"serve_width_bucket[w={w}]", fn, (keys, z, z, z))
+        results.append(ProbeResult(f"serve_width_bucket[w={w}]", "ok"))
+    return results
 
 
 def run_trace_check(symbols=None):

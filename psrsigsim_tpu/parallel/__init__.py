@@ -1,7 +1,8 @@
 """Mesh sharding and ensemble parallelism (TPU-native; the reference has no
 parallel layer — SURVEY.md §2.1)."""
 
-from .ensemble import FoldEnsemble, MultiPulsarFoldEnsemble
+from .ensemble import (FoldEnsemble, MultiPulsarFoldEnsemble,
+                       build_width_bucket_fn)
 from .seqshard import (
     SEQ_AXIS,
     SEQ_RNG_BLOCK,
@@ -28,6 +29,7 @@ from .mesh import (
 __all__ = [
     "FoldEnsemble",
     "MultiPulsarFoldEnsemble",
+    "build_width_bucket_fn",
     "make_mesh",
     "batch_sharding",
     "replicated_sharding",

@@ -33,7 +33,43 @@ try:  # jax >= 0.6 stable API, else the experimental home
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["FoldEnsemble", "MultiPulsarFoldEnsemble"]
+__all__ = ["FoldEnsemble", "MultiPulsarFoldEnsemble", "build_width_bucket_fn"]
+
+
+def build_width_bucket_fn(cfg, profiles):
+    """The serving layer's width-bucketed batch entry: a pure function
+
+        fn(keys, dms, norms, null_fracs) -> (B, Nchan, Nph) float32
+
+    mapping a batch of per-request inputs through :func:`fold_pipeline`
+    (with the per-request ``null_frac`` traced) and reducing each
+    observation to its folded pulse profile (sum over subintegrations —
+    the standard served data product, :meth:`FoldEnsemble.folded_profiles`
+    semantics in-graph).
+
+    The function is width-agnostic at trace time;
+    :class:`psrsigsim_tpu.serve.ProgramRegistry` AOT-compiles it once per
+    (geometry, bucket width) so serving never retraces.  Every per-request
+    random draw is keyed by the request's own key, so a row's bytes depend
+    only on that request — the property the serving layer's
+    batching-invariance contract (solo == coalesced == any bucket width)
+    is pinned against in tests/test_serve.py.
+    """
+    prof = jnp.asarray(profiles, jnp.float32)
+    freqs = jnp.asarray(cfg.meta.dat_freq_mhz(), dtype=jnp.float32)
+    chan_ids = jnp.arange(cfg.meta.nchan)
+    nchan, nsub, nph = cfg.meta.nchan, cfg.nsub, cfg.nph
+
+    def _batch(keys, dms, norms, null_fracs):
+        out = jax.vmap(
+            lambda k, d, n, nf: fold_pipeline(
+                k, d, n, prof, cfg, freqs=freqs, chan_ids=chan_ids,
+                null_frac=nf)
+        )(keys, dms, norms, null_fracs)
+        b = out.shape[0]
+        return out.reshape(b, nchan, nsub, nph).sum(axis=2)
+
+    return _batch
 
 
 def _split_packed_chunk(packed, nbin):

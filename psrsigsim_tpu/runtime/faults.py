@@ -40,6 +40,22 @@ point                     where it fires
                           host case for the study's kill/resume tests).
                           Config: ``{"after_start": int}``; omit to kill
                           after the first chunk commit.
+``serve.kill``            the serving result cache
+                          (:meth:`psrsigsim_tpu.serve.ResultCache.put`),
+                          immediately after the journal commit of the
+                          ``after_puts``-th artifact this process wrote
+                          — SIGKILLs the serving process (the preempted-
+                          server case: tests/serve_runner.py proves the
+                          relaunched server verifies its cache and
+                          serves the committed results without device
+                          execution).  Config: ``{"after_puts": int}``;
+                          omit to kill after the first commit.
+``serve.reject``          :meth:`psrsigsim_tpu.serve.SimulationService.
+                          submit` — the admission check force-rejects
+                          the request (with a retry-after) exactly as a
+                          saturated queue would, exercising the client-
+                          visible backpressure path.  Config: ``times``
+                          only.
 ========================  ====================================================
 
 Arming is explicit and local: a :class:`FaultPlan` is built by a test and
@@ -64,7 +80,7 @@ import signal
 __all__ = ["FaultPlan", "should_fire", "crash_process", "POINTS"]
 
 POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
-          "run.kill", "mc.kill")
+          "run.kill", "mc.kill", "serve.kill", "serve.reject")
 
 
 class FaultPlan:

@@ -24,12 +24,63 @@ the pipeline actually pays.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 
-__all__ = ["StageTimers", "STAGES"]
+__all__ = ["StageTimers", "STAGES", "LATENCY_LOG10_LO", "LATENCY_LOG10_HI",
+           "LATENCY_NBINS", "latency_bin_index", "latency_bin_edges"]
 
 STAGES = ("dispatch", "fetch", "encode", "write")
+
+# Bounded per-stage latency histogram: fixed equal bins over
+# log10(seconds) in [LATENCY_LOG10_LO, LATENCY_LOG10_HI), out-of-range
+# samples clamped into the edge bins — the host-side mirror of
+# ``ops/stats.fixed_histogram`` semantics (equal bins, clamp-not-drop),
+# applied to log-latency so microsecond encode calls and multi-second
+# device dispatches share one fixed-size table.  10 bins per decade from
+# 1 us to 100 s: memory is ``nbins`` ints per stage, forever bounded.
+LATENCY_LOG10_LO = -6.0
+LATENCY_LOG10_HI = 2.0
+LATENCY_NBINS = 80
+
+
+def latency_bin_index(seconds):
+    """The histogram bin a latency sample lands in (clamped into the edge
+    bins exactly like ``fixed_histogram`` clamps its tails)."""
+    s = max(float(seconds), 1e-30)
+    span = LATENCY_LOG10_HI - LATENCY_LOG10_LO
+    idx = int(math.floor(
+        (math.log10(s) - LATENCY_LOG10_LO) / span * LATENCY_NBINS))
+    return min(max(idx, 0), LATENCY_NBINS - 1)
+
+
+def latency_bin_edges():
+    """Bin UPPER edges in SECONDS (len ``LATENCY_NBINS``): bin ``i``
+    spans ``[edges[i-1], edges[i])`` (lower edge of bin 0 is
+    ``10**LATENCY_LOG10_LO``), with out-of-range samples clamped into
+    bins 0 and ``LATENCY_NBINS - 1``."""
+    span = LATENCY_LOG10_HI - LATENCY_LOG10_LO
+    return [10.0 ** (LATENCY_LOG10_LO + (i + 1) * span / LATENCY_NBINS)
+            for i in range(LATENCY_NBINS)]
+
+
+def _hist_percentile(counts, q):
+    """Percentile estimate from the fixed-bin histogram: the UPPER edge
+    (in seconds) of the bin where the cumulative count crosses ``q`` —
+    conservative (never under-reports) and exact to one bin width
+    (~26% in time, 10 bins/decade)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    edges = latency_bin_edges()
+    target = q * total
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= target:
+            return edges[i]
+    return edges[-1]
 
 
 class StageTimers:
@@ -40,15 +91,24 @@ class StageTimers:
     host-side accumulator merge as ``"reduce"`` — so a consumer with a
     different pipeline shape reuses the same accumulator, snapshot
     format, and bottleneck logic instead of growing a parallel one.
+
+    ``latency_stages`` names stages that record END-TO-END latency
+    rather than exclusive busy time (the serving engine's ``"request"``
+    stage spans queue wait + batch window + compute, once per request):
+    they get the same histograms/percentiles but are excluded from the
+    ``bottleneck`` pick, which compares exclusive busy totals — an e2e
+    stage double-counts every other stage and would always win.
     """
 
-    def __init__(self, extra_stages=()):
+    def __init__(self, extra_stages=(), latency_stages=()):
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._stages = tuple(STAGES) + tuple(
             s for s in extra_stages if s not in STAGES)
+        self._latency_stages = frozenset(latency_stages)
         self._seconds = {k: 0.0 for k in self._stages}
         self._calls = {k: 0 for k in self._stages}
+        self._hist = {k: [0] * LATENCY_NBINS for k in self._stages}
         self._bytes_fetched = 0
         self._depths = {}  # queue name -> [sum, samples, max]
 
@@ -57,15 +117,32 @@ class StageTimers:
         :data:`STAGES` or a declared extra stage; an undeclared name is
         registered on first use so a shared timer object never throws
         from a reporting thread); ``nbytes`` counts device->host payload
-        bytes (fetch stage only, by convention)."""
+        bytes (fetch stage only, by convention).  Each call also lands
+        one sample in the stage's bounded latency histogram, from which
+        :meth:`snapshot` reports p50/p95/p99."""
         with self._lock:
             if stage not in self._seconds:
                 self._stages = self._stages + (stage,)
                 self._seconds[stage] = 0.0
                 self._calls[stage] = 0
+                self._hist[stage] = [0] * LATENCY_NBINS
             self._seconds[stage] += float(seconds)
             self._calls[stage] += 1
+            self._hist[stage][latency_bin_index(seconds)] += 1
             self._bytes_fetched += int(nbytes)
+
+    def histogram(self, stage):
+        """A copy of one stage's latency-histogram counts (len
+        :data:`LATENCY_NBINS`; bin semantics in :func:`latency_bin_index`)."""
+        with self._lock:
+            return list(self._hist.get(stage, [0] * LATENCY_NBINS))
+
+    def percentile(self, stage, q):
+        """Latency percentile ``q`` (0..1) for ``stage``, estimated from
+        the bounded histogram (conservative: the crossing bin's upper
+        edge; 0.0 when the stage never reported)."""
+        with self._lock:
+            return _hist_percentile(self._hist.get(stage, ()), q)
 
     def depth(self, name, value):
         """Record one bounded-queue depth sample (e.g. the fetched-chunk
@@ -88,11 +165,20 @@ class StageTimers:
             for k in self._stages:
                 out[f"{k}_s"] = round(self._seconds[k], 6)
                 out[f"{k}_calls"] = self._calls[k]
+                if self._calls[k]:
+                    # per-call latency percentiles from the bounded
+                    # histogram (satellite of the serving PR: /metrics
+                    # and bench JSON report p50/p95/p99 per stage)
+                    for tag, q in (("p50", 0.50), ("p95", 0.95),
+                                   ("p99", 0.99)):
+                        out[f"{k}_{tag}_s"] = round(
+                            _hist_percentile(self._hist[k], q), 6)
             out["bytes_fetched"] = self._bytes_fetched
             out["wall_s"] = round(time.perf_counter() - self._t0, 6)
             for name, (tot, n, mx) in sorted(self._depths.items()):
                 out[f"{name}_depth_max"] = mx
                 out[f"{name}_depth_mean"] = round(tot / max(n, 1), 3)
-            out["bottleneck"] = max(self._stages,
-                                    key=lambda k: self._seconds[k])
+            busy = [k for k in self._stages
+                    if k not in self._latency_stages] or list(self._stages)
+            out["bottleneck"] = max(busy, key=lambda k: self._seconds[k])
             return out

@@ -1,0 +1,50 @@
+"""Simulation serving layer: concurrent requests -> device batches.
+
+The subsystem that turns the batch pipelines into a service
+(ROADMAP north star: "serves heavy traffic"):
+
+- :mod:`~psrsigsim_tpu.serve.spec` — canonical request specs: strict
+  validation, canonical JSON, sha256 content addresses, geometry
+  bucketing.
+- :mod:`~psrsigsim_tpu.serve.service` —
+  :class:`SimulationService`: bounded admission queue with explicit
+  backpressure and per-request deadlines, a batcher thread coalescing
+  compatible requests into padded width buckets, batching-invariant
+  per-request RNG (results bit-identical solo vs coalesced vs any
+  bucket width), stage telemetry.
+- :mod:`~psrsigsim_tpu.serve.programs` —
+  :class:`ProgramRegistry`: one AOT-compiled program per (geometry,
+  width), warmed at startup, retrace-guarded, persistent-compilation-
+  cache-backed so restart cold-start is bounded.
+- :mod:`~psrsigsim_tpu.serve.cache` — :class:`ResultCache`:
+  content-addressed journaled artifacts (PR-2 fsync discipline) so
+  repeated identical requests never touch the device and a SIGKILL'd
+  server restarts with its committed results verified and servable.
+- :mod:`~psrsigsim_tpu.serve.http` / ``python -m psrsigsim_tpu.serve``
+  — the stdlib ThreadingHTTPServer JSON API (``/simulate``,
+  ``/status/<id>``, ``/result/<id>``, ``/healthz``, ``/metrics``) with
+  graceful drain on SIGTERM.
+"""
+
+from .cache import ResultCache
+from .programs import DEFAULT_WIDTHS, ProgramRegistry, enable_compilation_cache
+from .service import (RequestFailed, RequestRejected, SERVE_STAGES,
+                      SimulationService)
+from .spec import (SpecError, build_geometry, canonicalize, geometry_hash,
+                   spec_hash)
+
+__all__ = [
+    "SimulationService",
+    "RequestRejected",
+    "RequestFailed",
+    "ResultCache",
+    "ProgramRegistry",
+    "DEFAULT_WIDTHS",
+    "SERVE_STAGES",
+    "SpecError",
+    "canonicalize",
+    "spec_hash",
+    "geometry_hash",
+    "build_geometry",
+    "enable_compilation_cache",
+]

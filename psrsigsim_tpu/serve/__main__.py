@@ -1,0 +1,98 @@
+"""``python -m psrsigsim_tpu.serve`` — the simulation serving daemon.
+
+Starts the dynamic-batching request engine behind the stdlib HTTP JSON
+API (:mod:`psrsigsim_tpu.serve.http`) and prints ONE machine-parseable
+ready line to stdout (``{"ready": true, "port": ...}``) once the socket
+is bound and warmup (if any) finished — the contract the subprocess
+test runner (tests/serve_runner.py) and shell scripts wait on.
+
+Example::
+
+    python -m psrsigsim_tpu.serve --port 8641 --cache-dir /var/tmp/pss \
+        --warmup warmspec.json
+    curl -s localhost:8641/simulate -d @spec.json
+    curl -s localhost:8641/metrics
+
+``--warmup`` takes a JSON file holding one spec object or a list of
+them; each geometry is staged and AOT-compiled for every bucket width
+before the ready line prints, so first-request latency is bounded (and,
+with the persistent compilation cache under the cache dir, restart
+warmup is a disk read).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m psrsigsim_tpu.serve",
+        description="dynamic-batching pulsar-simulation HTTP server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8641,
+                    help="0 picks a free port (printed in the ready line)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="content-addressed result cache root (also hosts "
+                         "the persistent compilation cache); omit to "
+                         "disable caching")
+    ap.add_argument("--widths", default="1,8,32",
+                    help="comma-separated bucket widths")
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--batch-window-ms", type=float, default=2.0)
+    ap.add_argument("--warmup", default=None,
+                    help="JSON file: one spec (or a list) whose geometries "
+                         "are compiled before the ready line")
+    ap.add_argument("--verify-cache", action="store_true",
+                    help="re-hash every cached artifact against the "
+                         "journal on startup (the relaunch-after-crash "
+                         "mode)")
+    ap.add_argument("--fault-plan", default=None,
+                    help="TESTS ONLY: FaultPlan JSON "
+                         '({"scratch_dir", "spec"}) arming serve.* points')
+    args = ap.parse_args(argv)
+
+    # keep stdout clean for the one-line ready protocol: the OO layer's
+    # reference-parity warnings print to stdout during warmup
+    real_stdout = sys.stdout
+    sys.stdout = sys.stderr
+
+    from .http import make_server, run_server
+    from .service import SimulationService
+
+    faults = None
+    if args.fault_plan:
+        from ..runtime import FaultPlan
+
+        with open(args.fault_plan) as f:
+            plan = json.load(f)
+        faults = FaultPlan(plan["scratch_dir"], plan["spec"])
+
+    widths = tuple(int(w) for w in args.widths.split(","))
+    service = SimulationService(
+        cache_dir=args.cache_dir, widths=widths, max_queue=args.max_queue,
+        batch_window_s=args.batch_window_ms / 1e3,
+        verify_cache=args.verify_cache, faults=faults)
+
+    if args.warmup:
+        with open(args.warmup) as f:
+            specs = json.load(f)
+        for spec in specs if isinstance(specs, list) else [specs]:
+            service.warmup(spec)
+
+    srv = make_server(args.host, args.port, service=service)
+
+    def _ready(s):
+        print(json.dumps({"ready": True, "host": args.host,
+                          "port": s.server_port,
+                          "cache": bool(args.cache_dir)}),
+              file=real_stdout, flush=True)
+
+    run_server(srv, ready_cb=_ready)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,209 @@
+"""Content-addressed result cache: sha256(canonical spec) -> journaled artifact.
+
+Repeated identical requests must be served without touching the device,
+and a SIGKILL'd server must come back with every committed result intact
+— so the cache reuses the PR-2 journal discipline end to end:
+
+* Artifacts are ``.npy`` files written temp + fsync + rename (a crash
+  leaves the old artifact or the new one, never a torn file).
+* Every commit appends one fsync'd line to an append-only
+  ``cache_journal.jsonl`` carrying the artifact's sha256, byte size, and
+  shape/dtype — THE durable record.  On open, the journal is replayed
+  with torn-tail truncation (a fragment with no newline is cut off, not
+  welded to the next run's records).
+* ``verify=True`` (the relaunched-server path) re-hashes every indexed
+  artifact against its journal record; an artifact that is missing,
+  truncated, or torn is dropped from the index (and the next request for
+  it recomputes) instead of being served corrupt.
+
+The ``serve.kill`` fault point fires here, immediately after a journal
+commit, so tests/serve_runner.py can SIGKILL the serving process at the
+exact boundary the durability contract is written against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import threading
+
+import numpy as np
+
+from ..runtime.faults import crash_process, should_fire
+
+__all__ = ["ResultCache"]
+
+_JOURNAL_NAME = "cache_journal.jsonl"
+
+
+class ResultCache:
+    """Crash-safe content-addressed artifact store for served results.
+
+    Thread-safe: the HTTP threads, the batcher, and ``/metrics`` all call
+    in concurrently; every index/journal mutation is under one lock (file
+    writes of distinct artifacts could proceed in parallel, but serving
+    artifacts are small — simplicity wins).
+    """
+
+    def __init__(self, cache_dir, verify=False, faults=None):
+        self.cache_dir = str(cache_dir)
+        self.results_dir = os.path.join(self.cache_dir, "results")
+        os.makedirs(self.results_dir, exist_ok=True)
+        self.journal_path = os.path.join(self.cache_dir, _JOURNAL_NAME)
+        self._lock = threading.Lock()
+        self._journal_f = None
+        self._faults = faults
+        self._index = {}       # spec hash -> journal record
+        self._puts = 0         # commits by THIS process (serve.kill arm)
+        self.hits = 0
+        self.misses = 0
+        self.verified = 0      # artifacts re-hashed ok on open
+        self.dropped = 0       # artifacts dropped by verify
+        self._load_journal()
+        if verify:
+            self.verify_all()
+
+    # -- open / verify -----------------------------------------------------
+
+    def _load_journal(self):
+        """Replay the journal; truncate a torn tail (mirrors the run
+        supervisor: appending after a newline-less fragment would weld
+        this run's first record onto it, losing BOTH)."""
+        valid_end = 0
+        try:
+            with open(self.journal_path, "rb") as f:
+                for line in f:
+                    if not line.endswith(b"\n"):
+                        break
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break
+                    valid_end += len(line)
+                    if rec.get("e") == "put":
+                        self._index[rec["hash"]] = rec
+        except FileNotFoundError:
+            return
+        if valid_end < os.path.getsize(self.journal_path):
+            with open(self.journal_path, "rb+") as f:
+                f.truncate(valid_end)
+
+    def verify_all(self):
+        """Re-hash every indexed artifact against its journal record;
+        drop entries whose file is missing or whose bytes differ.
+        Returns ``(verified, dropped)`` counts."""
+        with self._lock:
+            bad = []
+            for h, rec in self._index.items():
+                path = self._artifact_path(h)
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    bad.append(h)
+                    continue
+                if hashlib.sha256(data).hexdigest() != rec["sha256"]:
+                    bad.append(h)
+                    continue
+                self.verified += 1
+            for h in bad:
+                del self._index[h]
+                try:
+                    os.unlink(self._artifact_path(h))
+                except OSError:
+                    pass
+            self.dropped += len(bad)
+            return self.verified, self.dropped
+
+    # -- lookup / commit ---------------------------------------------------
+
+    def _artifact_path(self, h):
+        return os.path.join(self.results_dir, f"{h}.npy")
+
+    def __contains__(self, h):
+        with self._lock:
+            return h in self._index
+
+    def __len__(self):
+        with self._lock:
+            return len(self._index)
+
+    def get(self, h):
+        """The cached artifact for spec hash ``h`` (a numpy array), or
+        None on miss.  A hit never touches the device — the serving
+        engine's device-call counter is asserted against exactly this."""
+        with self._lock:
+            rec = self._index.get(h)
+        if rec is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            arr = np.load(self._artifact_path(h))
+        except (OSError, ValueError):
+            # artifact vanished/torn since open: behave like a miss and
+            # drop the index entry so the result is recomputed, not 500'd
+            with self._lock:
+                self._index.pop(h, None)
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return arr
+
+    def put(self, h, array, meta=None):
+        """Commit one artifact: atomic file write, then the fsync'd
+        journal line that makes it durable.  Idempotent per hash (a
+        concurrent duplicate put is a no-op).  Returns the journal
+        record."""
+        array = np.ascontiguousarray(array)
+        buf = io.BytesIO()
+        np.save(buf, array)
+        payload = buf.getvalue()
+        sha = hashlib.sha256(payload).hexdigest()
+        rec = {"e": "put", "hash": h, "sha256": sha,
+               "nbytes": len(payload), "shape": list(array.shape),
+               "dtype": str(array.dtype)}
+        if meta:
+            rec["meta"] = dict(meta)
+        with self._lock:
+            if h in self._index:
+                return self._index[h]
+            path = self._artifact_path(h)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if self._journal_f is None:
+                self._journal_f = open(self.journal_path, "a")
+            self._journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._journal_f.flush()
+            os.fsync(self._journal_f.fileno())
+            self._index[h] = rec
+            self._puts += 1
+            puts = self._puts
+        # serve.kill: die AFTER the durable commit — the relaunch must
+        # find exactly `after_puts` artifacts, verified and servable
+        if self._faults is not None:
+            cfg = self._faults.config("serve.kill")
+            if cfg is not None and puts >= int(cfg.get("after_puts", 1)):
+                if should_fire(self._faults, "serve.kill", token=h):
+                    crash_process()
+        return rec
+
+    def stats(self):
+        """JSON-ready counters for ``/metrics``."""
+        with self._lock:
+            return {"entries": len(self._index), "hits": self.hits,
+                    "misses": self.misses, "verified": self.verified,
+                    "dropped": self.dropped, "puts": self._puts}
+
+    def close(self):
+        with self._lock:
+            if self._journal_f is not None:
+                self._journal_f.close()
+                self._journal_f = None

@@ -1,0 +1,180 @@
+"""Thin stdlib HTTP front end over :class:`SimulationService`.
+
+A ``ThreadingHTTPServer`` JSON API — no framework, no dependency:
+
+=====================  =====================================================
+endpoint               behavior
+=====================  =====================================================
+``POST /simulate``     body: the request spec JSON.  202 + ``{"id",
+                       "status"}`` on admission; 200 with the result
+                       inline when the body carries ``"wait": seconds``
+                       (or when the cache answered instantly); 400 on a
+                       bad spec (every bad field named); 429 + a
+                       ``Retry-After`` header on backpressure; 503 +
+                       ``Retry-After`` while draining.
+``GET /status/<id>``   200 ``{"id", "status", ...}``; 404 unknown.
+``GET /result/<id>``   200 ``{"id", "shape", "dtype", "profile": [[...]]}``
+                       when done; 409 while queued/running; 410 for
+                       expired/errored; 404 unknown.
+``GET /healthz``       200 ``{"ok": true, "queue_depth", "draining"}``.
+``GET /metrics``       200: the service metrics dict — stage seconds +
+                       latency p50/p95/p99, queue depths, per-bucket
+                       program hit counts, cache stats.
+=====================  =====================================================
+
+Graceful drain: SIGTERM (and SIGINT) flips the service into draining —
+new submits get 503, in-flight requests finish, the cache journal is
+closed — then the listener shuts down.  SIGKILL is the tested crash
+path: the content-addressed cache journal guarantees committed results
+survive (tests/serve_runner.py).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .service import RequestRejected, SimulationService
+from .spec import SpecError
+
+__all__ = ["ServeHandler", "make_server", "run_server"]
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    server_version = "psrsigsim-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # the service rides on the server object (make_server attaches it)
+    @property
+    def service(self):
+        return self.server.service
+
+    def log_message(self, fmt, *args):  # quiet: one JSON line per request
+        pass
+
+    def _reply(self, code, obj, headers=()):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    # -- POST /simulate ----------------------------------------------------
+
+    def do_POST(self):
+        if self.path.rstrip("/") != "/simulate":
+            return self._reply(404, {"error": f"no such endpoint {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as err:
+            return self._reply(400, {"error": f"bad JSON body: {err}"})
+        if not isinstance(body, dict):
+            return self._reply(
+                400, {"error": "spec body must be a JSON object"})
+        try:
+            wait_s = body.pop("wait", None)
+            wait_s = None if wait_s is None else float(wait_s)
+            deadline_s = body.pop("deadline_s", None)
+            deadline_s = None if deadline_s is None else float(deadline_s)
+        except (TypeError, ValueError):
+            return self._reply(
+                400, {"error": "wait / deadline_s must be numbers"})
+        try:
+            rid, status = self.service.submit(body, deadline_s=deadline_s)
+        except SpecError as err:
+            return self._reply(400, {"error": "invalid spec",
+                                     "fields": err.errors})
+        except RequestRejected as err:
+            code = 503 if err.draining else 429
+            return self._reply(
+                code, {"error": err.reason,
+                       "retry_after_s": err.retry_after_s},
+                headers=[("Retry-After",
+                          f"{max(err.retry_after_s, 0.001):.3f}")])
+        if wait_s is not None:
+            return self._send_result(rid, timeout=wait_s)
+        return self._reply(200 if status == "done" else 202,
+                           {"id": rid, "status": status})
+
+    # -- GETs --------------------------------------------------------------
+
+    def do_GET(self):
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            m = self.service.metrics()
+            return self._reply(200, {"ok": True,
+                                     "queue_depth": m["queue_depth"],
+                                     "draining": m["draining"]})
+        if path == "/metrics":
+            return self._reply(200, self.service.metrics())
+        if path.startswith("/status/"):
+            rid = path[len("/status/"):]
+            try:
+                return self._reply(200, self.service.status(rid))
+            except KeyError:
+                return self._reply(404, {"error": f"unknown request {rid}"})
+        if path.startswith("/result/"):
+            return self._send_result(path[len("/result/"):], timeout=0.0)
+        return self._reply(404, {"error": f"no such endpoint {self.path}"})
+
+    def _send_result(self, rid, timeout):
+        from .service import RequestFailed
+
+        try:
+            arr = self.service.result(rid, timeout=timeout)
+        except KeyError:
+            return self._reply(404, {"error": f"unknown request {rid}"})
+        except TimeoutError:
+            try:
+                st = self.service.status(rid)
+            except KeyError:
+                st = {"id": rid, "status": "unknown"}
+            return self._reply(409, {**st, "error": "not done yet"})
+        except RequestFailed as err:
+            return self._reply(410, {"id": rid, "status": err.status,
+                                     "error": err.detail})
+        st = self.service.status(rid)
+        return self._reply(200, {
+            "id": rid, "status": "done", "cached": st.get("cached", False),
+            "shape": list(arr.shape), "dtype": str(arr.dtype),
+            "profile": arr.tolist()})
+
+
+def make_server(host="127.0.0.1", port=0, service=None, **service_kw):
+    """A ``ThreadingHTTPServer`` bound to (host, port) with a
+    :class:`SimulationService` attached (built from ``service_kw`` when
+    not given).  ``port=0`` picks a free port (``server.server_port``)."""
+    srv = ThreadingHTTPServer((host, port), ServeHandler)
+    srv.daemon_threads = True
+    srv.service = (service if service is not None
+                   else SimulationService(**service_kw))
+    return srv
+
+
+def run_server(srv, install_signals=True, ready_cb=None):
+    """Serve until SIGTERM/SIGINT, then drain gracefully: stop admitting
+    (503 + Retry-After), finish in-flight batches, close the cache
+    journal, stop the listener."""
+    stop = threading.Event()
+
+    def _drain(signum, frame):
+        stop.set()
+        # shutdown() must come from another thread than serve_forever's
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    if install_signals:
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
+    if ready_cb is not None:
+        ready_cb(srv)
+    try:
+        srv.serve_forever(poll_interval=0.05)
+    finally:
+        srv.service.close()
+        srv.server_close()

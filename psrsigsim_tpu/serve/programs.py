@@ -1,0 +1,200 @@
+"""Shape-bucketed program registry: one AOT-compiled program per
+(geometry, bucket width).
+
+Serving traffic must never pay a trace: a retrace inside the batcher
+stalls every queued request behind a multi-second compile, which is how
+a serving process melts under exactly the load it exists for.  The
+registry therefore:
+
+* AOT-lowers the width-bucketed batch function
+  (:func:`psrsigsim_tpu.parallel.build_width_bucket_fn`) once per
+  (geometry hash, width) at registration time — ``jit(fn).lower(...)
+  .compile()`` — and serves every batch through the compiled executable,
+  which by construction cannot retrace.
+* Counts compiles per key; :meth:`assert_single_compile` is the
+  retrace-count guard the tests pin (== 1 per bucket after warmup).
+* Optionally wires the JAX persistent compilation cache to a directory,
+  so a restarted server's warmup is a disk read instead of a recompile —
+  bounded cold-start.
+
+Widths are the powers the batcher rounds batches up to (padded rows are
+replicas of row 0 and are trimmed after execution); ``bucket_width``
+picks the smallest admitted width that fits.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["ProgramRegistry", "DEFAULT_WIDTHS", "enable_compilation_cache"]
+
+DEFAULT_WIDTHS = (1, 8, 32)
+
+
+def enable_compilation_cache(path):
+    """Point JAX's persistent compilation cache at ``path`` (created by
+    JAX on first write).  Returns True when the option stuck — older/newer
+    JAX spellings are tried in order and absence is non-fatal (serving
+    still works; restarts just pay compiles again)."""
+    import jax
+
+    ok = False
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        ok = True
+    except AttributeError:  # pragma: no cover - config name drift
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.set_cache_dir(str(path))
+            ok = True
+        except Exception:
+            return False
+    # cache even instant compiles: the serving programs are small on CPU
+    # test geometries but the REAL cost this exists for is TPU warmup
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # noqa: BLE001 - option names drift across jax
+            pass
+    return ok
+
+
+class ProgramRegistry:
+    """Compiled serving programs, keyed by (geometry hash, width).
+
+    One instance per service; thread-safe (registration happens on the
+    batcher thread or at warmup, lookups from anywhere).
+    """
+
+    def __init__(self, widths=DEFAULT_WIDTHS, compile_cache_dir=None):
+        widths = sorted(set(int(w) for w in widths))
+        if not widths or widths[0] < 1:
+            raise ValueError(f"widths must be positive ints, got {widths}")
+        self.widths = tuple(widths)
+        self._lock = threading.Lock()
+        self._geoms = {}          # geom hash -> (cfg, profiles, noise_norm)
+        self._programs = {}       # (geom hash, width) -> compiled executable
+        self._compile_counts = {}  # (geom hash, width) -> int
+        self._calls = {}          # (geom hash, width) -> executions
+        self.device_calls = 0
+        self.cache_enabled = (
+            enable_compilation_cache(compile_cache_dir)
+            if compile_cache_dir else False)
+
+    # -- geometry staging --------------------------------------------------
+
+    def geometry(self, geom_hash):
+        """The staged ``(cfg, profiles, noise_norm)`` for a registered
+        geometry (KeyError when unknown)."""
+        with self._lock:
+            return self._geoms[geom_hash]
+
+    def known(self, geom_hash):
+        with self._lock:
+            return geom_hash in self._geoms
+
+    def register(self, geom_hash, cfg, profiles, noise_norm, warmup=True):
+        """Stage one geometry bucket; with ``warmup`` (the default) every
+        admitted width is AOT-compiled NOW, so the first request of this
+        geometry pays zero compile on the serving path."""
+        with self._lock:
+            if geom_hash not in self._geoms:
+                self._geoms[geom_hash] = (cfg, np.asarray(profiles),
+                                          float(noise_norm))
+        if warmup:
+            for w in self.widths:
+                self.program(geom_hash, w)
+
+    # -- programs ----------------------------------------------------------
+
+    def bucket_width(self, n):
+        """The smallest admitted width >= ``n`` (the largest width when
+        ``n`` exceeds every bucket — the batcher then splits)."""
+        for w in self.widths:
+            if w >= n:
+                return w
+        return self.widths[-1]
+
+    def _example_inputs(self, width):
+        import jax
+
+        keys = jax.vmap(jax.random.key)(np.arange(width, dtype=np.uint32))
+        z = np.zeros(width, np.float32)
+        return keys, z, z, z
+
+    def program(self, geom_hash, width):
+        """The compiled executable for (geometry, width); AOT-compiles on
+        first use (warmup makes that never the serving path) and counts
+        every compile for the retrace guard."""
+        key = (geom_hash, int(width))
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                return prog
+            cfg, profiles, _ = self._geoms[geom_hash]
+        import jax
+
+        from ..parallel.ensemble import build_width_bucket_fn
+
+        fn = build_width_bucket_fn(cfg, profiles)
+        lowered = jax.jit(fn).lower(*self._example_inputs(int(width)))
+        compiled = lowered.compile()
+        with self._lock:
+            # a concurrent compile of the same key keeps the first one
+            # (both are valid; counts record what actually happened)
+            self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
+            prog = self._programs.setdefault(key, compiled)
+        return prog
+
+    def execute(self, geom_hash, width, keys, dms, norms, null_fracs):
+        """Run one padded batch through the compiled program.  This is
+        the ONLY device entry of the serving layer; ``device_calls``
+        counts its invocations (the result-cache tests assert it stays
+        flat across repeated identical requests)."""
+        prog = self.program(geom_hash, width)
+        out = prog(keys, dms, norms, null_fracs)
+        key = (geom_hash, int(width))
+        with self._lock:
+            self.device_calls += 1
+            self._calls[key] = self._calls.get(key, 0) + 1
+        return out
+
+    # -- introspection / guards -------------------------------------------
+
+    def compile_counts(self):
+        with self._lock:
+            return dict(self._compile_counts)
+
+    def call_counts(self):
+        with self._lock:
+            return dict(self._calls)
+
+    def assert_single_compile(self):
+        """The retrace-count guard: every (geometry, width) compiled
+        exactly once.  AOT executables cannot retrace, so >1 here means a
+        registration raced or a program was rebuilt — either way the
+        bounded-cold-start contract broke."""
+        bad = {k: c for k, c in self.compile_counts().items() if c != 1}
+        if bad:
+            raise AssertionError(
+                f"serving programs compiled more than once: {bad}")
+
+    def stats(self):
+        """JSON-ready summary for ``/metrics``: per-bucket execution
+        counts keyed ``geomprefix/width``, compile counts, device calls."""
+        with self._lock:
+            return {
+                "device_calls": self.device_calls,
+                "geometries": len(self._geoms),
+                "programs": len(self._programs),
+                "compile_counts": {
+                    f"{g[:12]}/w{w}": c
+                    for (g, w), c in sorted(self._compile_counts.items())},
+                "bucket_calls": {
+                    f"{g[:12]}/w{w}": c
+                    for (g, w), c in sorted(self._calls.items())},
+            }

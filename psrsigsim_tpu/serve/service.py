@@ -1,0 +1,450 @@
+"""The in-process simulation request engine: dynamic batching with
+admission control, deadlines, and batching-invariant results.
+
+``SimulationService`` is the layer between "a concurrent stream of
+request dicts" and "padded device batches through precompiled programs":
+
+* **Admission** — a bounded queue with explicit backpressure: a full
+  queue (or an armed ``serve.reject`` fault, or a draining server)
+  rejects with :class:`RequestRejected` carrying ``retry_after_s`` —
+  the client is told to come back, never silently stalled.  Per-request
+  deadlines expire queued work cleanly before it wastes device time.
+* **Coalescing** — a batcher thread groups compatible requests (same
+  geometry hash) arriving within a short window, rounds the group up to
+  a bucket width (padded rows replicate row 0 and are trimmed), and
+  executes ONE compiled program per batch
+  (:class:`~psrsigsim_tpu.serve.ProgramRegistry`).
+* **Batching invariance** — each request's PRNG key derives from
+  (seed, canonical-spec hash) on the dedicated ``"serve"`` RNG stage, so
+  a result is bit-identical whether the request ran alone, coalesced
+  with strangers, or in a different bucket width (the serving analogue
+  of the ensemble layer's chunk invariance; pinned by
+  tests/test_serve.py).
+* **Result cache** — a hit in the content-addressed cache
+  (:class:`~psrsigsim_tpu.serve.ResultCache`) completes the request at
+  submit time without touching the queue or the device.
+* **Telemetry** — enqueue/batch/compute/respond stage seconds plus an
+  end-to-end ``request`` latency histogram accumulate in a shared
+  :class:`~psrsigsim_tpu.runtime.StageTimers` (p50/p95/p99 in
+  ``/metrics`` and the bench record).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ..runtime.faults import should_fire
+from ..runtime.telemetry import StageTimers
+from .cache import ResultCache
+from .programs import DEFAULT_WIDTHS, ProgramRegistry
+from .spec import build_geometry, canonicalize, geometry_hash, spec_hash
+
+__all__ = ["SimulationService", "RequestRejected", "RequestFailed",
+           "SERVE_STAGES", "SERVE_LATENCY_STAGES"]
+
+#: stages the serving engine reports into StageTimers: per-call busy
+#: seconds for the engine's four phases plus the e2e request latency
+SERVE_STAGES = ("enqueue", "batch", "compute", "respond", "request")
+
+#: stages of SERVE_STAGES that are end-to-end latencies, not exclusive
+#: busy time — excluded from the snapshot's ``bottleneck`` pick
+SERVE_LATENCY_STAGES = ("request",)
+
+
+class RequestRejected(Exception):
+    """Admission control said no.  ``retry_after_s`` is the client's
+    backoff hint (the HTTP layer maps this to 429/503 + Retry-After)."""
+
+    def __init__(self, reason, retry_after_s=0.5, draining=False):
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        self.draining = bool(draining)
+        super().__init__(f"request rejected: {reason} "
+                         f"(retry after {retry_after_s:.2f}s)")
+
+
+class RequestFailed(Exception):
+    """A terminal non-success outcome surfaced by :meth:`result`."""
+
+    def __init__(self, status, detail):
+        self.status = status
+        self.detail = detail
+        super().__init__(f"request {status}: {detail}")
+
+
+class _Request:
+    __slots__ = ("id", "canonical", "geom_hash", "status", "error",
+                 "result", "cached", "done", "t_submit", "deadline")
+
+    def __init__(self, rid, canonical, geom_hash, deadline):
+        self.id = rid
+        self.canonical = canonical
+        self.geom_hash = geom_hash
+        self.status = "queued"
+        self.error = None
+        self.result = None
+        self.cached = False
+        self.done = threading.Event()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline
+
+
+class SimulationService:
+    """Dynamic-batching simulation serving engine (module docstring).
+
+    Parameters
+    ----------
+    cache_dir : str or None
+        Root of the content-addressed result cache (and, under
+        ``compile_cache/``, the persistent compilation cache unless
+        overridden).  None disables both caches (every request executes).
+    widths : tuple of int
+        Admitted bucket widths (batches round up to the smallest fit).
+    max_queue : int
+        Bound on QUEUED requests; beyond it submits are rejected with a
+        retry-after (running/done requests don't count).
+    batch_window_s : float
+        How long the batcher holds the head request open for strangers
+        to coalesce with (the latency cost of throughput).
+    verify_cache : bool
+        Re-hash every cached artifact against the journal on startup —
+        the relaunched-server mode (serve_runner uses it).
+    telemetry : StageTimers, optional
+        Shared timer object; by default the service owns one.
+    faults : FaultPlan, optional
+        Arms ``serve.kill`` / ``serve.reject`` (tests only).
+    """
+
+    def __init__(self, cache_dir=None, widths=DEFAULT_WIDTHS, max_queue=64,
+                 batch_window_s=0.002, retry_after_s=0.5, telemetry=None,
+                 faults=None, verify_cache=False, compile_cache_dir=None,
+                 max_done=1024):
+        import os
+
+        if compile_cache_dir is None and cache_dir is not None:
+            compile_cache_dir = os.path.join(str(cache_dir), "compile_cache")
+        self.registry = ProgramRegistry(widths,
+                                        compile_cache_dir=compile_cache_dir)
+        self.cache = (ResultCache(cache_dir, verify=verify_cache,
+                                  faults=faults)
+                      if cache_dir is not None else None)
+        self.timers = (telemetry if telemetry is not None
+                       else StageTimers(extra_stages=SERVE_STAGES,
+                                        latency_stages=SERVE_LATENCY_STAGES))
+        self.max_queue = int(max_queue)
+        self.batch_window_s = float(batch_window_s)
+        self.retry_after_s = float(retry_after_s)
+        self.max_done = int(max_done)
+        self._faults = faults
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._requests = OrderedDict()
+        self._draining = False
+        self.rejected = 0
+        self.expired = 0
+        self.cache_hits = 0
+        self.served = 0
+        self._batcher = threading.Thread(target=self._batch_loop,
+                                         daemon=True, name="pss-serve-batch")
+        self._batcher.start()
+
+    # -- public API --------------------------------------------------------
+
+    def warmup(self, spec):
+        """Stage a geometry before traffic: validate, build the fold
+        config, AOT-compile every bucket width (persistent-cache-backed
+        when configured).  Returns the geometry hash."""
+        canonical = canonicalize(spec)
+        gh = geometry_hash(canonical)
+        if not self.registry.known(gh):
+            cfg, profiles, noise_norm = build_geometry(canonical)
+            self.registry.register(gh, cfg, profiles, noise_norm,
+                                   warmup=True)
+        return gh
+
+    def submit(self, spec, deadline_s=None):
+        """Admit one request; returns ``(request_id, status)`` where
+        status is ``"done"`` (cache hit — no queue, no device),
+        ``"queued"``, or the status of an identical in-flight request it
+        coalesced onto.  Raises :class:`~psrsigsim_tpu.serve.SpecError`
+        on a bad spec and :class:`RequestRejected` on backpressure."""
+        t0 = time.perf_counter()
+        canonical = canonicalize(spec)
+        rid = spec_hash(canonical)
+        gh = geometry_hash(canonical)
+        deadline = (t0 + float(deadline_s)
+                    if deadline_s is not None else None)
+
+        with self._cond:
+            coalesced = self._coalesce(rid, deadline)
+            if coalesced is not None:
+                return rid, coalesced
+
+        cached_arr = self.cache.get(rid) if self.cache is not None else None
+        if cached_arr is not None:
+            req = _Request(rid, canonical, gh, None)
+            req.status = "done"
+            req.cached = True
+            req.result = cached_arr
+            req.done.set()
+            with self._cond:
+                self._requests[rid] = req
+                self.cache_hits += 1
+                self._evict_terminal()
+            self.timers.add("enqueue", time.perf_counter() - t0)
+            self.timers.add("request", time.perf_counter() - t0)
+            return rid, "done"
+
+        with self._cond:
+            # re-check under the lock: a concurrent identical submit may
+            # have enqueued between the first check and here (TOCTOU) —
+            # without this, two threads would both enqueue the same
+            # content and the batch would execute it twice
+            coalesced = self._coalesce(rid, deadline)
+            if coalesced is not None:
+                return rid, coalesced
+            if self._draining:
+                self.rejected += 1
+                raise RequestRejected("server draining",
+                                      self.retry_after_s, draining=True)
+            if should_fire(self._faults, "serve.reject", token=rid):
+                self.rejected += 1
+                raise RequestRejected("injected admission rejection",
+                                      self.retry_after_s)
+            if len(self._queue) >= self.max_queue:
+                self.rejected += 1
+                raise RequestRejected(
+                    f"queue full ({self.max_queue})", self.retry_after_s)
+            req = _Request(rid, canonical, gh, deadline)
+            self._requests[rid] = req
+            self._queue.append(req)
+            self.timers.depth("serve_queue", len(self._queue))
+            self._cond.notify_all()
+        self.timers.add("enqueue", time.perf_counter() - t0)
+        return rid, "queued"
+
+    def _coalesce(self, rid, deadline):
+        """Coalesce onto an identical in-flight/completed request
+        (content-addressed identity): returns its status, or None when
+        there is nothing live to coalesce onto (expired/errored entries
+        allow resubmission).  A resubmit carrying an EARLIER deadline
+        tightens the pending request's — the strictest client wins,
+        instead of the second deadline being silently dropped.  Caller
+        holds the lock."""
+        req = self._requests.get(rid)
+        if req is None or req.status not in ("queued", "running", "done"):
+            return None
+        if deadline is not None and not req.done.is_set():
+            if req.deadline is None or deadline < req.deadline:
+                req.deadline = deadline
+        return req.status
+
+    def status(self, rid):
+        """JSON-ready status for one request id (KeyError when unknown —
+        which includes terminal requests evicted from the bounded status
+        table whose results live on in the cache)."""
+        with self._cond:
+            req = self._requests.get(rid)
+            if req is None:
+                if self.cache is not None and rid in self.cache:
+                    return {"id": rid, "status": "done", "cached": True}
+                raise KeyError(rid)
+            out = {"id": rid, "status": req.status, "cached": req.cached}
+            if req.error is not None:
+                out["error"] = req.error
+            return out
+
+    def result(self, rid, timeout=None):
+        """Block for a request's folded-profile artifact
+        (``(Nchan, Nph)`` float32).  Raises KeyError (unknown id),
+        TimeoutError, or :class:`RequestFailed` (expired/error)."""
+        with self._cond:
+            req = self._requests.get(rid)
+        if req is None:
+            if self.cache is not None:
+                arr = self.cache.get(rid)
+                if arr is not None:
+                    return arr
+            raise KeyError(rid)
+        if not req.done.wait(timeout):
+            raise TimeoutError(f"request {rid[:12]} still {req.status}")
+        if req.status != "done":
+            raise RequestFailed(req.status, req.error or req.status)
+        if req.result is not None:
+            return req.result
+        if self.cache is not None:
+            arr = self.cache.get(rid)
+            if arr is not None:
+                return arr
+        raise RequestFailed("error", "result artifact unavailable")
+
+    def drain(self, timeout=30.0):
+        """Graceful shutdown: stop admitting, let the batcher finish the
+        queue, join it.  Returns True when fully drained."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        self._batcher.join(timeout)
+        return not self._batcher.is_alive()
+
+    def close(self, timeout=30.0):
+        ok = self.drain(timeout)
+        if self.cache is not None:
+            self.cache.close()
+        return ok
+
+    def metrics(self):
+        """One JSON-ready dict: stage timers (with latency percentiles),
+        queue depth, admission counters, per-bucket program hit counts,
+        and cache stats — the ``/metrics`` payload."""
+        with self._cond:
+            depth = len(self._queue)
+            out = {
+                "queue_depth": depth,
+                "max_queue": self.max_queue,
+                "draining": self._draining,
+                "served": self.served,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "cache_hits": self.cache_hits,
+            }
+        out["stages"] = self.timers.snapshot()
+        out["programs"] = self.registry.stats()
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
+
+    # -- the batcher -------------------------------------------------------
+
+    def _take_batch(self):
+        """Wait for work; hold the head request open for the coalescing
+        window; return the same-geometry batch (up to the widest bucket)
+        or None when draining with an empty queue."""
+        max_w = self.registry.widths[-1]
+        with self._cond:
+            while not self._queue:
+                if self._draining:
+                    return None
+                self._cond.wait(0.05)
+            head = self._queue[0]
+            gh = head.geom_hash
+            while not self._draining:
+                same = [r for r in self._queue if r.geom_hash == gh]
+                if len(same) >= max_w:
+                    break
+                remaining = (head.t_submit + self.batch_window_s
+                             - time.perf_counter())
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch = [r for r in self._queue if r.geom_hash == gh][:max_w]
+            for r in batch:
+                self._queue.remove(r)
+            return batch
+
+    def _expire(self, batch):
+        """Drop queued requests whose deadline passed — cleanly, before
+        any device time is spent on them."""
+        now = time.perf_counter()
+        alive = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                r.status = "expired"
+                r.error = "deadline exceeded before execution"
+                with self._cond:
+                    self.expired += 1
+                r.done.set()
+            else:
+                alive.append(r)
+        return alive
+
+    def _request_key(self, canonical, rid):
+        """The request's PRNG key: (seed, spec-hash) folded on the
+        ``"serve"`` stage — a pure function of the canonical spec, which
+        is the whole batching-invariance argument."""
+        import jax
+
+        from ..utils.rng import stage_key
+
+        root = jax.random.key(canonical["seed"])
+        h64 = int(rid[:16], 16)
+        k = stage_key(root, "serve", h64 & 0x7FFFFFFF)
+        return jax.random.fold_in(k, (h64 >> 31) & 0x7FFFFFFF)
+
+    def _execute(self, batch):
+        import jax.numpy as jnp
+
+        gh = batch[0].geom_hash
+        t0 = time.perf_counter()
+        for r in batch:
+            r.status = "running"
+        if not self.registry.known(gh):
+            cfg, profiles, noise_norm = build_geometry(batch[0].canonical)
+            self.registry.register(gh, cfg, profiles, noise_norm,
+                                   warmup=True)
+        _, _, noise_norm = self.registry.geometry(gh)
+        width = self.registry.bucket_width(len(batch))
+        idx = [i % len(batch) for i in range(width)]  # pad: wrap rows
+        keys = jnp.stack([self._request_key(batch[i].canonical,
+                                            batch[i].id) for i in idx])
+        dms = np.asarray([batch[i].canonical["dm"] for i in idx],
+                         np.float32)
+        norms = np.asarray(
+            [noise_norm * batch[i].canonical["noise_scale"] for i in idx],
+            np.float32)
+        nulls = np.asarray([batch[i].canonical["null_frac"] for i in idx],
+                           np.float32)
+        self.timers.add("batch", time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        out = np.asarray(
+            self.registry.execute(gh, width, keys, dms, norms, nulls))
+        self.timers.add("compute", time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        now = time.perf_counter()
+        for i, r in enumerate(batch):
+            arr = np.ascontiguousarray(out[i])
+            if self.cache is not None:
+                self.cache.put(r.id, arr, meta={"geom": gh[:12]})
+            r.result = arr
+            r.status = "done"
+            r.done.set()
+            self.timers.add("request", now - r.t_submit)
+        with self._cond:
+            self.served += len(batch)
+            self._evict_terminal()
+        self.timers.add("respond", time.perf_counter() - t0)
+
+    def _batch_loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            batch = self._expire(batch)
+            if not batch:
+                continue
+            try:
+                self._execute(batch)
+            except BaseException as err:  # noqa: BLE001 - batcher must live
+                # a poisoned geometry/batch fails ITS requests, never the
+                # engine: every later request would otherwise hang forever
+                for r in batch:
+                    if not r.done.is_set():
+                        r.status = "error"
+                        r.error = f"{type(err).__name__}: {err}"
+                        r.done.set()
+
+    def _evict_terminal(self):
+        """Bound the status table: oldest TERMINAL requests beyond
+        ``max_done`` are dropped (their artifacts live on in the cache).
+        Caller holds the lock."""
+        terminal = [rid for rid, r in self._requests.items()
+                    if r.done.is_set()]
+        excess = len(terminal) - self.max_done
+        for rid in terminal[:max(excess, 0)]:
+            del self._requests[rid]

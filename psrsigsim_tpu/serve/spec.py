@@ -1,0 +1,176 @@
+"""Canonical simulation request specs: validation, canonicalization, hashing.
+
+A serving request is a plain JSON dict describing one fold-mode
+observation — pulsar, telescope, geometry, plus the per-request knobs
+(``seed``, ``dm``, ``noise_scale``, ``null_frac``).  Everything the
+serving layer does hangs off two derived identities:
+
+* ``spec_hash`` — sha256 of the canonical JSON of the FULL spec.  It is
+  the request id, the content address of the result cache entry, and
+  (folded into the PRNG key with the seed) the root of the request's
+  random streams — so a result is a pure function of its spec.
+* ``geometry_hash`` — sha256 of the canonical JSON of the subset of
+  fields that determine the compiled program (everything except
+  ``seed``/``dm``/``noise_scale``/``null_frac``).  Requests sharing a
+  geometry hash coalesce into one device batch and share one compiled
+  program per bucket width.
+
+Canonicalization is strict on purpose: unknown keys are rejected loudly
+(a typo like ``noise_scael`` silently defaulting would serve the wrong
+physics and cache it forever under a hash the caller believes means
+something else), numeric fields are normalized to float/int before
+hashing so ``1`` and ``1.0`` address the same result, and validation
+errors name every bad field at once.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+__all__ = ["SpecError", "canonicalize", "spec_hash", "geometry_hash",
+           "geometry_fields", "build_geometry", "REQUEST_FIELDS",
+           "GEOMETRY_FIELDS"]
+
+
+class SpecError(ValueError):
+    """A request spec failed validation; ``errors`` lists every problem."""
+
+    def __init__(self, errors):
+        self.errors = list(errors)
+        super().__init__("invalid request spec: " + "; ".join(self.errors))
+
+
+# field -> (type caster, default or REQUIRED, (lo, hi) inclusive bounds)
+_REQUIRED = object()
+
+#: geometry/physics fields: together they determine the compiled program
+#: (static shapes + closed-over portrait and noise normalization)
+GEOMETRY_FIELDS = {
+    "nchan": (int, _REQUIRED, (1, 65536)),
+    "fcent_mhz": (float, _REQUIRED, (1.0, 1e6)),
+    "bw_mhz": (float, _REQUIRED, (0.001, 1e5)),
+    "sample_rate_mhz": (float, _REQUIRED, (1e-6, 1e4)),
+    "sublen_s": (float, _REQUIRED, (1e-4, 1e5)),
+    "tobs_s": (float, _REQUIRED, (1e-4, 1e6)),
+    "period_s": (float, _REQUIRED, (1e-5, 100.0)),
+    "smean_jy": (float, _REQUIRED, (0.0, 1e4)),
+    "profile_peak": (float, 0.5, (0.0, 1.0)),
+    "profile_width": (float, 0.05, (1e-4, 0.5)),
+    "profile_amp": (float, 1.0, (0.0, 1e3)),
+    "aperture_m": (float, 100.0, (1.0, 1e4)),
+    "area_m2": (float, 5500.0, (1.0, 1e7)),
+    "tsys_k": (float, 35.0, (0.1, 1e5)),
+}
+
+#: per-request fields: traced program inputs, free to vary inside a batch
+REQUEST_FIELDS = {
+    "seed": (int, _REQUIRED, (0, 2**31 - 1)),
+    "dm": (float, _REQUIRED, (0.0, 1e4)),
+    "noise_scale": (float, 1.0, (0.0, 1e3)),
+    "null_frac": (float, 0.0, (0.0, 1.0)),
+}
+
+_ALL_FIELDS = {**GEOMETRY_FIELDS, **REQUEST_FIELDS}
+
+
+def canonicalize(spec):
+    """Validate ``spec`` and return the canonical dict (defaults filled,
+    numerics normalized).  Raises :class:`SpecError` naming EVERY bad
+    field — unknown keys, missing required fields, wrong types, and
+    out-of-range values are all collected before raising."""
+    if not isinstance(spec, dict):
+        raise SpecError([f"spec must be a JSON object, got {type(spec).__name__}"])
+    errors = []
+    unknown = sorted(set(spec) - set(_ALL_FIELDS))
+    if unknown:
+        errors.append(f"unknown field(s) {unknown}; valid fields: "
+                      f"{sorted(_ALL_FIELDS)}")
+    out = {}
+    for name, (cast, default, (lo, hi)) in _ALL_FIELDS.items():
+        if name in spec:
+            raw = spec[name]
+            if isinstance(raw, bool) or isinstance(raw, (list, dict)):
+                errors.append(f"{name}: expected {cast.__name__}, "
+                              f"got {type(raw).__name__}")
+                continue
+            try:
+                val = cast(raw)
+            except (TypeError, ValueError):
+                errors.append(f"{name}: expected {cast.__name__}, "
+                              f"got {raw!r}")
+                continue
+            if cast is int and float(raw) != val:
+                errors.append(f"{name}: expected integer, got {raw!r}")
+                continue
+        elif default is _REQUIRED:
+            errors.append(f"{name}: required")
+            continue
+        else:
+            val = cast(default)
+        if not (lo <= val <= hi):
+            errors.append(f"{name}: {val!r} outside [{lo}, {hi}]")
+            continue
+        out[name] = val
+    if errors:
+        raise SpecError(errors)
+    return out
+
+
+def _canonical_json(d):
+    # sort_keys + tight separators + repr-stable floats: the SAME bytes
+    # for the same canonical spec on every process, forever — these bytes
+    # are the cache address and the PRNG fold, so format drift would both
+    # orphan every cached result and silently change served randomness
+    return json.dumps(d, sort_keys=True, separators=(",", ":"))
+
+
+def spec_hash(canonical):
+    """sha256 hex of the canonical spec (the request id / cache address)."""
+    return hashlib.sha256(_canonical_json(canonical).encode()).hexdigest()
+
+
+def geometry_fields(canonical):
+    """The geometry-only subset of a canonical spec."""
+    return {k: canonical[k] for k in GEOMETRY_FIELDS}
+
+
+def geometry_hash(canonical):
+    """sha256 hex of the geometry subset (the program-bucket key)."""
+    return hashlib.sha256(
+        _canonical_json(geometry_fields(canonical)).encode()).hexdigest()
+
+
+def build_geometry(canonical):
+    """Stage one geometry bucket: ``(cfg, profiles, noise_norm)`` from a
+    canonical spec's geometry fields, via the same OO configuration path
+    every other entry point uses (:func:`simulate.build_fold_config`), so
+    a served observation and a batch-CLI observation of the same physics
+    are configured identically."""
+    from ..models.pulsar.profiles import GaussProfile
+    from ..models.pulsar.pulsar import Pulsar
+    from ..models.telescope.backend import Backend
+    from ..models.telescope.receiver import Receiver
+    from ..models.telescope.telescope import Telescope
+    from ..signal import FilterBankSignal
+    from ..simulate import build_fold_config
+    from ..utils import make_quant
+
+    g = geometry_fields(canonical)
+    sig = FilterBankSignal(g["fcent_mhz"], g["bw_mhz"],
+                           Nsubband=g["nchan"],
+                           sample_rate=g["sample_rate_mhz"],
+                           sublen=g["sublen_s"], fold=True)
+    sig._tobs = make_quant(g["tobs_s"], "s")
+    psr = Pulsar(g["period_s"], g["smean_jy"],
+                 GaussProfile(peak=g["profile_peak"],
+                              width=g["profile_width"],
+                              amp=g["profile_amp"]),
+                 name="SERVE")
+    tscope = Telescope(g["aperture_m"], area=g["area_m2"],
+                       Tsys=g["tsys_k"], name="ServeScope")
+    tscope.add_system(
+        "ServeSys",
+        Receiver(fcent=g["fcent_mhz"], bandwidth=g["bw_mhz"], name="R"),
+        Backend(samprate=12.5, name="B"))
+    return build_fold_config(sig, psr, tscope, "ServeSys")

@@ -150,7 +150,7 @@ def _tile_periodic(prof, nsamp):
 
 @partial(jax.jit, static_argnames=("cfg",))
 def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
-                  extra_delays_ms=None):
+                  extra_delays_ms=None, null_frac=None):
     """One fold-mode observation: synthesis + dispersion + radiometer noise.
 
     Args:
@@ -175,6 +175,22 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
             :func:`~psrsigsim_tpu.models.ism.scatter_delays_ms`; reference
             applies each as its own serial per-channel pass,
             ism/ism.py:100-156,158-220).
+        null_frac: optional per-subint nulling probability (traced; the
+            serving layer's per-request knob).  Each subintegration is
+            independently nulled with this probability — the pulse term
+            is zeroed, radiometer noise still lands — drawn on the
+            ``"null_select"`` stage so the pulse/noise streams are
+            untouched; the same semantics (same stage key, same ordering
+            between synthesis and noise) as the Monte-Carlo study
+            engine's ``null_frac`` prior.  ``None`` (default) compiles
+            the null-free program; a traced ``0.0`` multiplies by an
+            all-ones mask — exact op-for-op (pinned eagerly by
+            tests/test_serve.py), though a fully jitted program may
+            still fuse differently than one with nulling compiled out
+            and move a last ulp (the same caveat as changing batch
+            width; what matters for serving is that the SAME program
+            handles every request, which is what makes results
+            batching-invariant).
 
     Returns:
         ``(Nchan, nsub*Nph)`` float32 block (unclipped — clipping belongs to
@@ -182,11 +198,11 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
     """
     return _fold_core(key, dm, noise_norm, cfg.nfold, cfg.draw_norm,
                       cfg.noise_df, profiles, cfg, freqs, chan_ids,
-                      extra_delays_ms)
+                      extra_delays_ms, null_frac=null_frac)
 
 
 def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
-               freqs, chan_ids, extra_delays_ms, dt_ms=None):
+               freqs, chan_ids, extra_delays_ms, dt_ms=None, null_frac=None):
     """Shared fold-mode observation body (synthesis + dispersion + noise);
     pulsar parameters may be static (homogeneous path) or traced (hetero,
     including the sample spacing ``dt_ms``)."""
@@ -217,6 +233,16 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
         block = jnp.tile(profiles, (1, cfg.nsub))
         block = block * _chan_chi2(kp, chan_ids, nfold, nsamp) * draw_norm
         block = fourier_shift(block, delays_ms, dt=dt)
+
+    if null_frac is not None:
+        # per-subint nulling between synthesis and noise (the nulled
+        # pulse vanishes; the radiometer keeps integrating) — op-for-op
+        # the Monte-Carlo study engine's null_frac prior semantics
+        ksel = stage_key(key, "null_select")
+        u = jax.random.uniform(ksel, (cfg.nsub,), jnp.float32)
+        live = (u >= jnp.asarray(null_frac, jnp.float32)).astype(jnp.float32)
+        block = (block.reshape(-1, cfg.nsub, cfg.nph)
+                 * live[None, :, None]).reshape(-1, nsamp)
 
     # radiometer noise — added after dispersion in the reference too
     # (telescope.observe runs after ism.disperse), so never shifted

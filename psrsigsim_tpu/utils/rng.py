@@ -35,6 +35,11 @@ STAGES = {
     # sampling lives on its own stage so a trial's prior draws can never
     # collide with the pipeline's pulse/noise streams for the same key
     "prior": 6,
+    # serving-layer request keys (psrsigsim_tpu.serve): each admitted
+    # request derives its stream from (seed, canonical-spec hash) on this
+    # stage, so a served result depends only on the request's content —
+    # never on which batch, bucket width, or process executed it
+    "serve": 7,
 }
 
 

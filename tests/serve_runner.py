@@ -1,0 +1,104 @@
+"""Subprocess driver for serving-layer kill/resume tests (tests/test_serve.py).
+
+``serve.kill`` SIGKILLs the whole serving process (the preempted-server
+case), so the pytest process cannot host the faulted server itself —
+this script runs the real HTTP server (``psrsigsim_tpu.serve``) as a
+subprocess, dies mid-traffic when the armed fault fires, and is launched
+again against the same cache dir (with ``--verify-cache``) to prove the
+content-addressed result cache survives: committed artifacts re-hash
+clean and are served WITHOUT device execution, in-flight requests that
+never committed re-execute cleanly.
+
+Usage::
+
+    python tests/serve_runner.py CACHE_DIR [--plan PLAN_JSON] [--port N]
+        [--widths 1,8] [--verify-cache]
+
+Prints one ready line ``{"ready": true, "port": ...}`` on stdout once
+the socket is bound and the fixed test geometry is warmed, then serves
+until killed.  ``PLAN_JSON`` holds ``{"scratch_dir": ..., "spec": ...}``
+for the :class:`~psrsigsim_tpu.runtime.faults.FaultPlan`.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# mirror tests/conftest.py BEFORE jax initializes: unit-test platform is
+# an 8-device virtual CPU so compiled shapes match the pytest process
+os.environ["JAX_PLATFORMS"] = os.environ.get("PSS_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: the fixed serving geometry every invocation warms (same physics as
+#: tests/fault_runner.py's export config, so data is cheap on CPU)
+BASE_SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05,
+    "seed": 3, "dm": 10.0,
+}
+
+
+def request_spec(i):
+    """The i-th deterministic test request (distinct content hashes)."""
+    return dict(BASE_SPEC, seed=100 + i, dm=10.0 + 0.5 * i)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cache_dir")
+    ap.add_argument("--plan", default=None)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--widths", default="1,8")
+    ap.add_argument("--verify-cache", action="store_true")
+    args = ap.parse_args(argv)
+
+    # keep stdout clean for the one-line ready protocol: the OO layer's
+    # reference-parity warnings (sub-Nyquist sampling etc.) print to
+    # stdout during warmup
+    real_stdout = sys.stdout
+    sys.stdout = sys.stderr
+
+    import jax
+
+    jax.config.update("jax_enable_x64", False)
+
+    from psrsigsim_tpu.runtime import FaultPlan
+    from psrsigsim_tpu.serve.http import make_server, run_server
+    from psrsigsim_tpu.serve.service import SimulationService
+
+    faults = None
+    if args.plan:
+        with open(args.plan) as f:
+            spec = json.load(f)
+        faults = FaultPlan(spec["scratch_dir"], spec["spec"])
+
+    service = SimulationService(
+        cache_dir=args.cache_dir,
+        widths=tuple(int(w) for w in args.widths.split(",")),
+        verify_cache=args.verify_cache, faults=faults,
+        batch_window_s=0.002)
+    service.warmup(BASE_SPEC)
+    srv = make_server("127.0.0.1", args.port, service=service)
+
+    def _ready(s):
+        print(json.dumps({"ready": True, "port": s.server_port,
+                          "verified": (service.cache.verified
+                                       if service.cache else 0),
+                          "dropped": (service.cache.dropped
+                                      if service.cache else 0)}),
+              file=real_stdout, flush=True)
+
+    run_server(srv, ready_cb=_ready)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

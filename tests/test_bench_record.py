@@ -1,0 +1,127 @@
+"""Schema/size tests for the citable bench record (VERDICT r5 satellite):
+the final stdout line must stay under the driver's ~2000-char tail
+window WITH every measured config present, and the full detail must land
+on disk atomically — a bench run that measured a config and emitted a
+JSON without it must fail, not publish a silently truncated record."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def _synthetic_detail():
+    """A full-size detail dict shaped like a real complete run (nested
+    stage-timer dicts included, to make the size bound meaningful)."""
+    timers = {f"{s}_{k}": 1.234567 for s in
+              ("dispatch", "fetch", "encode", "write")
+              for k in ("s", "calls", "p50_s", "p95_s", "p99_s")}
+    single = {"nchan": 2048, "nsamp_per_chan": 40960,
+              "cpu_s_per_obs": 17.71, "tpu_s_per_obs": 0.006949,
+              "tpu_samples_per_sec": 7544866405, "speedup": 2549.59,
+              "slope_ok": True, "sync_ok": 0.983}
+    return {
+        "platform": "tpu",
+        "config1_fold64": dict(single),
+        "config2_fold2048": dict(single),
+        "config4_search_null": dict(single, n_null=12),
+        "config3_baseband": dict(single, npol=2),
+        "config5_ensemble": {"batch": 128, "batches_timed": 8,
+                             "slope_ok": True, "sync_ok": 0.99,
+                             "tpu_obs_per_sec": 3441.0,
+                             "cpu_obs_per_sec": 4.2,
+                             "tpu_samples_per_sec": 1.2e10,
+                             "speedup": 812.3},
+        "config5_multipulsar": {"n_pulsars": 128, "tpu_obs_per_sec": 14655.0,
+                                "cpu_s_per_obs": 0.04, "speedup": 621.0,
+                                "slope_ok": True, "sync_ok": 0.97},
+        "config6_mc": {"tpu_trials_per_sec": 210.0, "cpu_s_per_trial": 1.9,
+                       "speedup": 399.0, "slope_ok": True, "sync_ok": 1.01,
+                       "stage_timers": dict(timers),
+                       "bottleneck_stage": "dispatch"},
+        "config7_serve": {"n_requests": 64, "serial_req_per_sec": 1.8,
+                          "batched_req_per_sec": 41.0,
+                          "batched_over_serial": 22.8,
+                          "cache_hit_req_per_sec": 1900.0,
+                          "cache_hit_device_calls": 0,
+                          "request_p50_s": 0.02, "request_p95_s": 0.6,
+                          "request_p99_s": 0.9, "drained": True,
+                          "bottleneck_stage": "compute",
+                          "bucket_calls": {"w32": 2}},
+        "export_e2e": {"e2e_obs_per_sec": 16.9, "cpu_s_per_obs": 1.2,
+                       "speedup": 0.44, "packed_speedup": 0.56,
+                       "e2e_packed_obs_per_sec": 21.0,
+                       "machinery_speedup": 110.0,
+                       "stage_timers": dict(timers),
+                       "stage_timers_packed": dict(timers),
+                       "bottleneck_stage": "write",
+                       "compute_slope_ok": True},
+        "io_encode": {"native_available": True,
+                      "native_encode_selected": True,
+                      "encode_gate_ok": True,
+                      "subint_encode_speedup": 4.17},
+        "total_bench_s": 812.3,
+    }
+
+
+class TestSummaryLine:
+    def test_under_budget_and_parseable(self):
+        line = bench._summary_line(_synthetic_detail())
+        assert len(line) <= bench.SUMMARY_BUDGET
+        obj = json.loads(line)
+        assert obj["metric"] == "fold_ensemble_obs_per_sec"
+        assert obj["value"] == 3441.0 and obj["vs_baseline"] == 812.3
+
+    def test_every_measured_config_present_with_headline(self):
+        detail = _synthetic_detail()
+        obj = json.loads(bench._summary_line(detail))
+        measured = {k for k, v in detail.items() if isinstance(v, dict)}
+        assert measured == set(obj["cfg"])
+        # the fields VERDICT cites survive, per config
+        for name in ("config1_fold64", "config4_search_null",
+                     "config5_ensemble", "config5_multipulsar"):
+            assert obj["cfg"][name]["spd"] > 0
+            assert obj["cfg"][name]["ok"] is True
+        assert obj["cfg"]["config7_serve"]["req_s"] == 41.0
+        assert obj["cfg"]["export_e2e"]["pspd"] == 0.6  # round(0.56, 1)
+
+    def test_provisional_flag(self):
+        obj = json.loads(bench._summary_line(_synthetic_detail(),
+                                             provisional=True))
+        assert obj["provisional"] is True
+
+    def test_missing_config_fails_the_run(self):
+        detail = _synthetic_detail()
+        line = json.loads(bench._summary_line(detail))
+        del line["cfg"]["config1_fold64"]
+        with pytest.raises(RuntimeError, match="config1_fold64"):
+            bench._assert_summary_complete(detail, line)
+
+    def test_oversized_summary_fails_loudly(self, monkeypatch):
+        detail = _synthetic_detail()
+        # a pathological config name explosion must raise, not truncate
+        for i in range(200):
+            detail[f"config_padding_{i:03d}"] = {"speedup": 1.0}
+        with pytest.raises(RuntimeError, match="citable record"):
+            bench._summary_line(detail)
+
+
+class TestDetailFile:
+    def test_atomic_write_full_and_replaces(self, tmp_path):
+        path = str(tmp_path / "bench_full.json")
+        detail = _synthetic_detail()
+        bench._write_detail_atomic(detail, path=path)
+        with open(path) as f:
+            assert json.load(f) == json.loads(json.dumps(detail))
+        # second write replaces wholesale (no partial/merged hybrid)
+        detail2 = {"platform": "cpu", "config1_fold64": {"speedup": 2.0}}
+        bench._write_detail_atomic(detail2, path=path)
+        with open(path) as f:
+            assert json.load(f) == detail2
+        assert not os.path.exists(path + ".tmp")

@@ -455,3 +455,13 @@ class TestTraceProbe:
     def test_probe_rejects_uncovered_symbol(self):
         with pytest.raises(AssertionError, match="no trace probe"):
             run_trace_check(["definitely_not_an_op"])
+
+    def test_serve_bucket_programs_trace_clean(self):
+        """The serving layer's width-bucketed batch programs trace,
+        abstract-eval, and hold a stable jit cache at every probed
+        bucket width (the dynamic twin of the serve registry's AOT
+        single-compile guard)."""
+        from psrsigsim_tpu.analysis.trace_check import run_serve_trace_check
+
+        results = run_serve_trace_check(widths=(1, 8))
+        assert [r.status for r in results] == ["ok", "ok"]

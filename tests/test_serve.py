@@ -1,0 +1,601 @@
+"""Serving-layer tests: spec canonicalization, batching invariance (the
+acceptance pin), result cache durability, admission control, the HTTP
+front end on loopback, and the subprocess kill/resume proof.
+
+Runs entirely in tier-1 on the CPU platform; the only sockets are
+loopback (`ThreadingHTTPServer` on 127.0.0.1 port 0).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.serve import (ResultCache, SimulationService, SpecError,
+                                 canonicalize, geometry_hash, spec_hash)
+from psrsigsim_tpu.serve.service import RequestFailed, RequestRejected
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: tiny fold geometry (cheap on the 8-device virtual CPU platform)
+SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05,
+    "seed": 3, "dm": 10.0,
+}
+
+
+def _service(tmp_path=None, **kw):
+    kw.setdefault("widths", (1, 8))
+    kw.setdefault("batch_window_s", 0.002)
+    cache_dir = str(tmp_path / "cache") if tmp_path is not None else None
+    return SimulationService(cache_dir=cache_dir, **kw)
+
+
+# ---------------------------------------------------------------------------
+# canonical specs
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_unknown_and_missing_fields_all_named(self):
+        with pytest.raises(SpecError) as err:
+            canonicalize({"nchan": 4, "bogus_field": 1})
+        msg = str(err.value)
+        assert "bogus_field" in msg and "fcent_mhz: required" in msg
+
+    def test_range_and_type_violations(self):
+        bad = dict(SPEC, nchan=2.5, dm=-1.0)
+        with pytest.raises(SpecError) as err:
+            canonicalize(bad)
+        msg = str(err.value)
+        assert "nchan" in msg and "dm" in msg
+
+    def test_numeric_normalization_stable_hash(self):
+        # 10 vs 10.0 for a float field must address the SAME result
+        a = canonicalize(dict(SPEC, dm=10))
+        b = canonicalize(dict(SPEC, dm=10.0))
+        assert spec_hash(a) == spec_hash(b)
+
+    def test_geometry_hash_ignores_request_knobs(self):
+        a = canonicalize(SPEC)
+        b = canonicalize(dict(SPEC, seed=99, dm=55.0, noise_scale=2.0,
+                              null_frac=0.3))
+        assert geometry_hash(a) == geometry_hash(b)
+        assert spec_hash(a) != spec_hash(b)
+
+    def test_defaults_filled(self):
+        c = canonicalize(SPEC)
+        assert c["noise_scale"] == 1.0 and c["null_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# batching invariance — the acceptance criterion
+# ---------------------------------------------------------------------------
+
+
+def _serve_with_strangers(widths, n_strangers, window):
+    """Serve SPEC through a service restricted to ``widths``, alongside
+    ``n_strangers`` distinct same-geometry requests, and return SPEC's
+    artifact bytes plus the registry's (width -> calls) map."""
+    svc = SimulationService(cache_dir=None, widths=widths,
+                            batch_window_s=window)
+    try:
+        svc.warmup(SPEC)
+        ids = [svc.submit(dict(SPEC, seed=100 + i, dm=12.0 + i))[0]
+               for i in range(n_strangers)]
+        rid, _ = svc.submit(SPEC)
+        out = svc.result(rid, timeout=120)
+        for i in ids:
+            svc.result(i, timeout=120)
+        svc.registry.assert_single_compile()
+        calls = {w: c for (_, w), c in svc.registry.call_counts().items()}
+        return np.ascontiguousarray(out).tobytes(), calls
+    finally:
+        svc.close()
+
+
+class TestBatchingInvariance:
+    @pytest.mark.slow
+    def test_solo_vs_coalesced_vs_bucket_widths(self):
+        """For a fixed spec+seed the served result is BIT-identical
+        whether it ran alone (width-1 program), coalesced with 6
+        strangers (width-8 program), or inside a width-32 batch."""
+        solo, c1 = _serve_with_strangers((1,), 0, 0.0)
+        co8, c8 = _serve_with_strangers((8,), 6, 0.1)
+        co32, c32 = _serve_with_strangers((32,), 20, 0.1)
+        assert 1 in c1 and 8 in c8 and 32 in c32
+        assert solo == co8 == co32
+
+    def test_solo_vs_width8(self):
+        """The fast tier-1 core of the invariance pin (widths 1 vs 8)."""
+        solo, _ = _serve_with_strangers((1,), 0, 0.0)
+        co8, c8 = _serve_with_strangers((8,), 4, 0.1)
+        assert 8 in c8
+        assert solo == co8
+
+    def test_retrace_count_one_per_bucket_after_warmup(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            svc.warmup(SPEC)
+            for i in range(10):
+                rid, _ = svc.submit(dict(SPEC, seed=200 + i))
+                svc.result(rid, timeout=120)
+            counts = svc.registry.compile_counts()
+            assert counts and all(c == 1 for c in counts.values()), counts
+            svc.registry.assert_single_compile()
+        finally:
+            svc.close()
+
+    def test_cache_hit_never_reexecutes(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            rid, _ = svc.submit(SPEC)
+            first = svc.result(rid, timeout=120)
+            calls = svc.registry.device_calls
+            rid2, status = svc.submit(SPEC)
+            assert rid2 == rid
+            again = svc.result(rid2, timeout=120)
+            assert svc.registry.device_calls == calls
+            assert first.tobytes() == again.tobytes()
+        finally:
+            svc.close()
+        # a FRESH service over the same cache dir (the restart path):
+        # its request table is empty, so the hit MUST come from the
+        # on-disk content-addressed cache — an in-process resubmit
+        # above is answered by the request table and proves nothing
+        # about ResultCache
+        svc2 = _service(tmp_path)
+        try:
+            rid3, status = svc2.submit(SPEC)
+            assert rid3 == rid and status == "done"
+            again2 = svc2.result(rid3, timeout=120)
+            assert svc2.registry.device_calls == 0
+            assert svc2.cache_hits == 1
+            assert first.tobytes() == again2.tobytes()
+        finally:
+            svc2.close()
+
+    def test_null_frac_zero_matches_null_free_pipeline(self):
+        """The always-traced null_frac input is a no-op at 0.0: op for
+        op (eager), the all-live mask multiply is BIT-exact against the
+        pipeline with nulling compiled out (``null_frac=None``).  The
+        jitted whole-program artifact is additionally pinned to float32
+        agreement — two DIFFERENT compiled programs may legitimately
+        fuse a last ulp apart (same caveat as changing batch width);
+        serving's bit-level contract is across widths of the SAME
+        program, covered above."""
+        import jax
+        import jax.numpy as jnp
+
+        from psrsigsim_tpu.serve.spec import build_geometry
+        from psrsigsim_tpu.simulate import fold_pipeline
+
+        svc = SimulationService(cache_dir=None, widths=(1,))
+        try:
+            rid, _ = svc.submit(SPEC)
+            served = svc.result(rid, timeout=120)
+            canonical = canonicalize(SPEC)
+            cfg, profiles, noise_norm = build_geometry(canonical)
+            prof = jnp.asarray(profiles, jnp.float32)
+            freqs = jnp.asarray(cfg.meta.dat_freq_mhz(), jnp.float32)
+            chan_ids = jnp.arange(cfg.meta.nchan)
+            key = svc._request_key(canonical, rid)
+            args = (key, jnp.float32(canonical["dm"]),
+                    jnp.float32(noise_norm), prof)
+            kw = dict(freqs=freqs, chan_ids=chan_ids)
+            # eager op-level pin: traced 0.0 nulling is bit-exact
+            with jax.disable_jit():
+                with_null = np.asarray(fold_pipeline(
+                    *args, cfg, null_frac=jnp.float32(0.0), **kw))
+                no_null = np.asarray(fold_pipeline(*args, cfg, **kw))
+            assert with_null.tobytes() == no_null.tobytes()
+            # whole-program pin: the served artifact agrees to float32
+            folded = no_null.reshape(cfg.meta.nchan, cfg.nsub,
+                                     cfg.nph).sum(axis=1)
+            np.testing.assert_allclose(served, folded, rtol=1e-5)
+        finally:
+            svc.close()
+
+    def test_null_frac_active_changes_result(self):
+        svc = SimulationService(cache_dir=None, widths=(1,))
+        try:
+            a, _ = svc.submit(SPEC)
+            b, _ = svc.submit(dict(SPEC, null_frac=0.9))
+            ra = svc.result(a, timeout=120)
+            rb = svc.result(b, timeout=120)
+            assert ra.tobytes() != rb.tobytes()
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# result cache durability
+# ---------------------------------------------------------------------------
+
+
+class TestResultCache:
+    def test_roundtrip_and_journal_replay(self, tmp_path):
+        d = str(tmp_path / "c")
+        c = ResultCache(d)
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        c.put("aa" * 32, arr)
+        c.close()
+        c2 = ResultCache(d)
+        got = c2.get("aa" * 32)
+        assert got is not None and got.tobytes() == arr.tobytes()
+        assert c2.get("bb" * 32) is None
+        c2.close()
+
+    def test_torn_journal_tail_truncated(self, tmp_path):
+        d = str(tmp_path / "c")
+        c = ResultCache(d)
+        c.put("aa" * 32, np.zeros(3, np.float32))
+        c.close()
+        with open(os.path.join(d, "cache_journal.jsonl"), "a") as f:
+            f.write('{"e": "put", "hash": "torn')  # no newline: torn write
+        c2 = ResultCache(d)
+        assert c2.get("aa" * 32) is not None
+        c2.put("cc" * 32, np.ones(3, np.float32))
+        c2.close()
+        # the torn fragment must not have welded onto the new record
+        c3 = ResultCache(d)
+        assert c3.get("cc" * 32) is not None
+        c3.close()
+
+    def test_verify_drops_corrupt_artifact(self, tmp_path):
+        d = str(tmp_path / "c")
+        c = ResultCache(d)
+        c.put("aa" * 32, np.zeros(4, np.float32))
+        c.put("bb" * 32, np.ones(4, np.float32))
+        c.close()
+        # corrupt one artifact on disk behind the journal's back
+        path = os.path.join(d, "results", "aa" * 32 + ".npy")
+        with open(path, "r+b") as f:
+            f.seek(-2, os.SEEK_END)
+            f.write(b"XX")
+        c2 = ResultCache(d, verify=True)
+        assert c2.verified == 1 and c2.dropped == 1
+        assert c2.get("aa" * 32) is None      # recompute, don't serve corrupt
+        assert c2.get("bb" * 32) is not None
+        c2.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control, deadlines, drain
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_queue_full_rejects_with_retry_after(self):
+        svc = SimulationService(cache_dir=None, widths=(1,), max_queue=0)
+        try:
+            with pytest.raises(RequestRejected) as err:
+                svc.submit(SPEC)
+            assert err.value.retry_after_s > 0
+            assert svc.rejected == 1
+        finally:
+            svc.close()
+
+    def test_injected_reject_then_success(self, tmp_path):
+        from psrsigsim_tpu.runtime import FaultPlan
+
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"serve.reject": {"times": 1}})
+        svc = _service(tmp_path, faults=plan)
+        try:
+            with pytest.raises(RequestRejected):
+                svc.submit(SPEC)
+            rid, _ = svc.submit(SPEC)          # the injected shot is spent
+            assert svc.result(rid, timeout=120).shape[0] == SPEC["nchan"]
+            assert plan.shots_fired("serve.reject") == 1
+        finally:
+            svc.close()
+
+    def test_deadline_expires_cleanly_without_device_time(self):
+        svc = SimulationService(cache_dir=None, widths=(1,),
+                                batch_window_s=0.0)
+        try:
+            svc.warmup(SPEC)
+            calls = svc.registry.device_calls
+            rid, _ = svc.submit(dict(SPEC, seed=501), deadline_s=-1.0)
+            with pytest.raises(RequestFailed) as err:
+                svc.result(rid, timeout=30)
+            assert err.value.status == "expired"
+            assert svc.registry.device_calls == calls
+            assert svc.expired == 1
+        finally:
+            svc.close()
+
+    def test_coalesced_resubmit_tightens_deadline(self, monkeypatch):
+        """A resubmit of an identical queued spec carrying an EARLIER
+        deadline must tighten the pending request's deadline (strictest
+        client wins) instead of being silently dropped at the coalesce
+        check."""
+        svc = SimulationService(cache_dir=None, widths=(1,),
+                                batch_window_s=0.0)
+        gate = threading.Event()
+        real_execute = svc._execute
+
+        def gated_execute(batch):
+            gate.wait(30)
+            real_execute(batch)
+
+        monkeypatch.setattr(svc, "_execute", gated_execute)
+        try:
+            svc.warmup(SPEC)
+            rid1, _ = svc.submit(dict(SPEC, seed=700))   # occupies batcher
+            rid2, st2 = svc.submit(dict(SPEC, seed=701))  # stays queued
+            assert st2 == "queued"
+            rid3, st3 = svc.submit(dict(SPEC, seed=701), deadline_s=-1.0)
+            assert rid3 == rid2 and st3 == "queued"       # coalesced
+            gate.set()
+            with pytest.raises(RequestFailed) as err:
+                svc.result(rid2, timeout=30)
+            assert err.value.status == "expired"          # tightened
+            svc.result(rid1, timeout=120)                 # stranger fine
+        finally:
+            gate.set()
+            svc.close()
+
+    def test_drain_rejects_new_work_and_finishes_queue(self):
+        svc = SimulationService(cache_dir=None, widths=(1, 8),
+                                batch_window_s=0.05)
+        rid, _ = svc.submit(SPEC)
+        assert svc.drain(timeout=120)
+        # queued work finished during the drain
+        assert svc.result(rid, timeout=1).shape[0] == SPEC["nchan"]
+        with pytest.raises(RequestRejected) as err:
+            svc.submit(dict(SPEC, seed=777))
+        assert err.value.draining
+        svc.close()
+
+    def test_poisoned_batch_fails_request_not_engine(self, monkeypatch):
+        import psrsigsim_tpu.serve.service as service_mod
+
+        svc = SimulationService(cache_dir=None, widths=(1,))
+        try:
+            def boom(canonical):
+                raise RuntimeError("synthetic geometry failure")
+
+            monkeypatch.setattr(service_mod, "build_geometry", boom)
+            rid, _ = svc.submit(dict(SPEC, seed=600))
+            with pytest.raises(RequestFailed) as err:
+                svc.result(rid, timeout=30)
+            assert "synthetic geometry failure" in err.value.detail
+            monkeypatch.undo()
+            # the batcher survived and serves the next request
+            rid2, _ = svc.submit(dict(SPEC, seed=601))
+            assert svc.result(rid2, timeout=120) is not None
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end (loopback)
+# ---------------------------------------------------------------------------
+
+
+def _post(base, path, obj, timeout=120):
+    req = urllib.request.Request(base + path, json.dumps(obj).encode(),
+                                 {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(base, path, timeout=120):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHTTP:
+    @pytest.fixture
+    def server(self, tmp_path):
+        from psrsigsim_tpu.serve.http import make_server
+
+        srv = make_server(port=0, cache_dir=str(tmp_path / "cache"),
+                          widths=(1, 8), batch_window_s=0.002)
+        srv.service.warmup(SPEC)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{srv.server_port}", srv
+        srv.shutdown()
+        srv.service.close()
+        srv.server_close()
+
+    def test_simulate_wait_status_result_metrics(self, server):
+        base, srv = server
+        code, body, _ = _post(base, "/simulate", dict(SPEC, wait=120))
+        assert code == 200 and body["status"] == "done"
+        rid = body["id"]
+        assert body["shape"] == [SPEC["nchan"],
+                                 len(body["profile"][0])]
+        code, st = _get(base, "/status/" + rid)
+        assert code == 200 and st["status"] == "done"
+        code, res = _get(base, "/result/" + rid)
+        assert code == 200 and res["dtype"] == "float32"
+        code, health = _get(base, "/healthz")
+        assert code == 200 and health["ok"]
+        code, m = _get(base, "/metrics")
+        assert code == 200
+        assert "request_p50_s" in m["stages"]
+        assert "request_p99_s" in m["stages"]
+        assert m["programs"]["bucket_calls"]       # per-bucket hit counts
+        assert m["cache"]["entries"] >= 1
+
+    def test_async_submit_then_poll(self, server):
+        base, _ = server
+        code, body, _ = _post(base, "/simulate", dict(SPEC, seed=41))
+        assert code in (200, 202)
+        rid = body["id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            code, res = _get(base, "/result/" + rid)
+            if code == 200:
+                break
+            assert code == 409      # pending, not an error
+            time.sleep(0.02)
+        assert code == 200
+
+    def test_bad_spec_400_names_fields(self, server):
+        base, _ = server
+        code, body, _ = _post(base, "/simulate", {"nchan": "x"})
+        assert code == 400
+        assert any("nchan" in e for e in body["fields"])
+
+    def test_unknown_id_404(self, server):
+        base, _ = server
+        assert _get(base, "/status/" + "0" * 64)[0] == 404
+        assert _get(base, "/result/" + "0" * 64)[0] == 404
+
+    def test_malformed_body_types_400_not_crash(self, server):
+        """A non-object JSON body or non-numeric wait/deadline_s must be
+        a clean 400, not an unhandled handler exception (which drops the
+        connection with a reset instead of an HTTP response)."""
+        base, _ = server
+        code, body, _ = _post(base, "/simulate", [1, 2])
+        assert code == 400 and "JSON object" in body["error"]
+        code, body, _ = _post(base, "/simulate", dict(SPEC, wait="soon"))
+        assert code == 400
+        code, body, _ = _post(base, "/simulate",
+                              dict(SPEC, deadline_s=[0.1]))
+        assert code == 400
+        assert _get(base, "/healthz")[0] == 200    # server survived
+
+    def test_injected_reject_maps_to_429_with_retry_after(self, tmp_path):
+        from psrsigsim_tpu.runtime import FaultPlan
+        from psrsigsim_tpu.serve.http import make_server
+
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"serve.reject": {"times": 1}})
+        srv = make_server(port=0, cache_dir=None, widths=(1,), faults=plan)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.server_port}"
+        try:
+            code, body, headers = _post(base, "/simulate", dict(SPEC))
+            assert code == 429 and "Retry-After" in headers
+            code, body, _ = _post(base, "/simulate", dict(SPEC, wait=120))
+            assert code == 200
+        finally:
+            srv.shutdown()
+            srv.service.close()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# kill / resume (subprocess, PR-2 style)
+# ---------------------------------------------------------------------------
+
+RUNNER = os.path.join(REPO, "tests", "serve_runner.py")
+
+
+def _launch_runner(cache_dir, plan_path=None, verify=False):
+    cmd = [sys.executable, RUNNER, str(cache_dir)]
+    if plan_path:
+        cmd += ["--plan", str(plan_path)]
+    if verify:
+        cmd += ["--verify-cache"]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline()
+    ready = json.loads(line)
+    assert ready["ready"]
+    return proc, ready
+
+
+@pytest.mark.faults
+class TestKillResume:
+    def test_sigkilled_server_resumes_with_cache_intact(self, tmp_path):
+        """The acceptance pin: serve.kill SIGKILLs the server right after
+        the 2nd artifact commit; the relaunched server re-hashes its
+        content-addressed cache clean and serves the committed results
+        WITHOUT device execution, while never-committed requests
+        re-execute cleanly."""
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        from serve_runner import request_spec
+
+        cache_dir = tmp_path / "cache"
+        plan_path = tmp_path / "plan.json"
+        plan_path.write_text(json.dumps({
+            "scratch_dir": str(tmp_path / "scratch"),
+            "spec": {"serve.kill": {"after_puts": 2}}}))
+
+        proc, ready = _launch_runner(cache_dir, plan_path=plan_path)
+        base = f"http://127.0.0.1:{ready['port']}"
+        specs = [request_spec(i) for i in range(4)]
+        served, interrupted = [], []
+        for i, spec in enumerate(specs):
+            try:
+                code, body, _ = _post(base, "/simulate",
+                                      dict(spec, wait=120), timeout=120)
+                assert code == 200
+                served.append(i)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                interrupted.append(i)
+                break
+        proc.wait(timeout=60)
+        assert proc.returncode == -signal.SIGKILL
+        # the fault fired after the 2nd commit: exactly 2 artifacts are
+        # durable, and at least one request was in flight at the kill
+        assert interrupted, "server should have died mid-request"
+        journal = (cache_dir / "cache_journal.jsonl").read_text()
+        committed = [json.loads(l)["hash"] for l in journal.splitlines()]
+        assert len(committed) == 2
+
+        # relaunch against the same cache dir, verify mode
+        proc2, ready2 = _launch_runner(cache_dir, verify=True)
+        try:
+            assert ready2["verified"] == 2 and ready2["dropped"] == 0
+            base = f"http://127.0.0.1:{ready2['port']}"
+            # committed results serve as cache hits, no device execution
+            for i in range(2):
+                code, body, _ = _post(base, "/simulate",
+                                      dict(specs[i], wait=120), timeout=120)
+                assert code == 200 and body["status"] == "done"
+                assert body["cached"] is True
+            _, m = _get(base, "/metrics")
+            assert m["programs"]["device_calls"] == 0
+            assert m["cache"]["hits"] >= 2
+            # the interrupted / never-committed requests re-execute
+            for i in range(2, 4):
+                code, body, _ = _post(base, "/simulate",
+                                      dict(specs[i], wait=120), timeout=120)
+                assert code == 200 and body["status"] == "done"
+            _, m = _get(base, "/metrics")
+            assert m["programs"]["device_calls"] >= 1
+        finally:
+            proc2.send_signal(signal.SIGTERM)
+            try:
+                proc2.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+
+    def test_sigterm_drains_gracefully(self, tmp_path):
+        """SIGTERM (not a fault — the orchestrated shutdown path): the
+        server finishes what it accepted and exits 0."""
+        proc, ready = _launch_runner(tmp_path / "cache")
+        base = f"http://127.0.0.1:{ready['port']}"
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        from serve_runner import request_spec
+
+        code, body, _ = _post(base, "/simulate",
+                              dict(request_spec(0), wait=120), timeout=120)
+        assert code == 200
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0

@@ -1,0 +1,129 @@
+"""StageTimers latency-histogram tests: bin-edge semantics (the
+host-side mirror of ``ops/stats.fixed_histogram``: equal bins, clamped
+tails), percentile estimation, and thread-safety under concurrent
+``add()`` — the serving layer's ``/metrics`` and the bench JSON both
+read these numbers."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.runtime import StageTimers
+from psrsigsim_tpu.runtime.telemetry import (LATENCY_LOG10_HI,
+                                             LATENCY_LOG10_LO,
+                                             LATENCY_NBINS,
+                                             latency_bin_edges,
+                                             latency_bin_index)
+
+
+class TestBinEdges:
+    def test_bin_count_and_monotone_edges(self):
+        edges = latency_bin_edges()
+        assert len(edges) == LATENCY_NBINS
+        assert all(a < b for a, b in zip(edges, edges[1:]))
+        # 10 bins per decade from 1 us to 100 s
+        assert edges[-1] == pytest.approx(10.0 ** LATENCY_LOG10_HI)
+        assert edges[0] == pytest.approx(
+            10.0 ** (LATENCY_LOG10_LO
+                     + (LATENCY_LOG10_HI - LATENCY_LOG10_LO)
+                     / LATENCY_NBINS))
+
+    def test_known_values_land_in_expected_bins(self):
+        # exact decade boundaries sit at the LOWER edge of their bin
+        # ([lo, hi) bins, floor semantics — fixed_histogram's convention)
+        assert latency_bin_index(1e-6) == 0
+        assert latency_bin_index(1e-3) == 30
+        assert latency_bin_index(1.0) == 60
+        assert latency_bin_index(10.0) == 70
+
+    def test_out_of_range_clamps_into_edge_bins(self):
+        # below-range and zero land in bin 0; above-range in the last bin
+        # (clamp-not-drop: tail mass is recorded, never silently lost)
+        assert latency_bin_index(1e-9) == 0
+        assert latency_bin_index(0.0) == 0
+        assert latency_bin_index(1e6) == LATENCY_NBINS - 1
+
+    def test_every_sample_lands_inside_its_bin_bounds(self):
+        edges = latency_bin_edges()
+        rng = np.random.default_rng(0)
+        for s in 10.0 ** rng.uniform(-5.9, 1.9, size=200):
+            i = latency_bin_index(s)
+            lower = edges[i - 1] if i else 10.0 ** LATENCY_LOG10_LO
+            assert lower <= s < edges[i] * (1 + 1e-12)
+
+
+class TestHistogramAccumulation:
+    def test_add_populates_histogram_and_percentiles(self):
+        t = StageTimers()
+        for _ in range(90):
+            t.add("fetch", 1e-3)
+        for _ in range(10):
+            t.add("fetch", 0.5)
+        hist = t.histogram("fetch")
+        assert sum(hist) == 100
+        assert hist[latency_bin_index(1e-3)] == 90
+        assert hist[latency_bin_index(0.5)] == 10
+        # p50 sits in the 1 ms bin, p99 in the 0.5 s bin; percentile
+        # reports the crossing bin's UPPER edge (conservative)
+        edges = latency_bin_edges()
+        assert t.percentile("fetch", 0.50) == pytest.approx(
+            edges[latency_bin_index(1e-3)])
+        assert t.percentile("fetch", 0.99) == pytest.approx(
+            edges[latency_bin_index(0.5)])
+        snap = t.snapshot()
+        assert snap["fetch_p50_s"] <= snap["fetch_p95_s"] <= snap["fetch_p99_s"]
+
+    def test_unreported_stage_has_no_percentile_keys(self):
+        t = StageTimers()
+        t.add("fetch", 1e-3)
+        snap = t.snapshot()
+        assert "fetch_p50_s" in snap
+        assert "write_p50_s" not in snap      # write never reported
+        assert t.percentile("write", 0.5) == 0.0
+
+    def test_first_use_registered_stage_gets_histogram(self):
+        t = StageTimers()
+        t.add("custom_stage", 2e-2)
+        assert sum(t.histogram("custom_stage")) == 1
+        assert t.snapshot()["custom_stage_p50_s"] > 0
+
+    def test_latency_stage_excluded_from_bottleneck(self):
+        """An e2e latency stage (serving's ``request``: queue wait +
+        batch window + compute, once per request) double-counts every
+        busy stage and would always win the bottleneck pick — it must
+        keep its histogram/percentiles but never be named bottleneck."""
+        t = StageTimers(extra_stages=("compute", "request"),
+                        latency_stages=("request",))
+        t.add("compute", 1.0)
+        t.add("request", 5.0)
+        snap = t.snapshot()
+        assert snap["bottleneck"] == "compute"
+        assert snap["request_p50_s"] > 0    # still measured and reported
+
+    def test_thread_safety_under_concurrent_add(self):
+        """8 threads x 500 adds each: no sample lost, every count in the
+        right bin (the serving batcher, HTTP threads, and fetch thread
+        all report into one shared object)."""
+        t = StageTimers(extra_stages=("enqueue",))
+        n_threads, n_each = 8, 500
+
+        def worker(tid):
+            val = 1e-4 if tid % 2 == 0 else 1e-1
+            for _ in range(n_each):
+                t.add("enqueue", val)
+                t.depth("serve_queue", tid)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        snap = t.snapshot()
+        assert snap["enqueue_calls"] == n_threads * n_each
+        hist = t.histogram("enqueue")
+        assert sum(hist) == n_threads * n_each
+        assert hist[latency_bin_index(1e-4)] == n_threads // 2 * n_each
+        assert hist[latency_bin_index(1e-1)] == n_threads // 2 * n_each
+        assert snap["serve_queue_depth_max"] == n_threads - 1
