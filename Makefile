@@ -9,12 +9,17 @@
 # merged statistics + artifact fingerprints at trial-chunk sizes
 # {32,128,512}, interrupted-sweep resume identity, stage timers present
 # (bench.py mc_smoke).
+# `make bench-scenarios` is the scenario-engine gate: disabled-is-free
+# byte identity, per-effect chunk/batching invariance, serve scenario
+# traffic counters, per-effect overhead vs the base pipeline
+# (bench.py scenario_smoke).
 # `make serve-smoke` is the serving-layer gate: batching invariance
 # across bucket widths {1,8,32}, cache hits with zero device calls,
 # one compile per (geometry, width), clean drain, batched-vs-serial
 # throughput + latency percentiles (bench.py serve_smoke).
 
-.PHONY: lint test test-faults bench-export bench-mc serve-smoke
+.PHONY: lint test test-faults bench-export bench-mc serve-smoke \
+	bench-scenarios
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -33,3 +38,6 @@ bench-mc:
 
 serve-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve-smoke
+
+bench-scenarios:
+	JAX_PLATFORMS=cpu python bench.py --scenario-smoke

@@ -666,21 +666,20 @@ def _export_compute_slope(ens, width):
     @partial(jax.jit, static_argnames=("k",))
     def _run_quant_k(root, dms_q, norms_q, k):
         # K back-to-back quantized chunks inside one program; the K-slope
-        # cancels the dispatch constant and the int16/float accumulators
-        # defeat DCE (see _timed_slope)
-        def body(i, accs):
+        # cancels the dispatch constant and the int16 accumulator defeats
+        # DCE (see _timed_slope).  The packed program is the ONLY
+        # quantized family (data+scl+offs fused in one buffer).
+        def body(i, acc):
             keys = jax.vmap(
                 lambda j: _stage_key(jax.random.fold_in(root, i),
                                      "user", j)
             )(idxq)
-            d, sc, of, _ = ens._run_sharded_quantized(
+            packed = ens._run_sharded_quantized_packed(
                 keys, dms_q, norms_q, ens._profiles, ens._freqs,
-                ens._chan_ids)
-            return (accs[0] + d, accs[1] + sc, accs[2] + of)
-        z = (jnp.zeros((qn, cfg.nsub, cfg.meta.nchan, cfg.nph),
-                       jnp.int16),
-             jnp.zeros((qn, cfg.nsub, cfg.meta.nchan), jnp.float32),
-             jnp.zeros((qn, cfg.nsub, cfg.meta.nchan), jnp.float32))
+                ens._chan_ids)[0]
+            return acc + packed
+        z = jnp.zeros((qn, cfg.nsub, cfg.meta.nchan, cfg.nph + 4),
+                      jnp.int16)
         return jax.lax.fori_loop(0, k, body, z)
 
     dms_q = jnp.full((qn,), ens.dm, jnp.float32)
@@ -795,13 +794,17 @@ def time_export_e2e(n_obs=None):
         # encoding (run_quantized no longer exposes byte_order — ADVICE
         # r5 #3), so drive them the way iter_chunks does: prepped inputs
         # into the BE-swapped programs.  "separate" is the pre-pipeline
-        # three-transfer triple; "fused" is the streaming exporter's
-        # single packed buffer (data+scl+offs), which dodges two of the
-        # three per-transfer fixed costs on relay links.
-        keys_q, dms_c, norms_c, pad_q = ens._prep_inputs(chunk, 4, None, None)
-        dev = ens._run_sharded_quantized_be(
+        # three-transfer triple (the packed buffer split back into
+        # data/scl/offs on device — the unfused program family itself is
+        # gone, one family keeps quantized bytes bit-identical across
+        # entry points); "fused" is the streaming exporter's single
+        # packed buffer, which dodges two of the three per-transfer
+        # fixed costs on relay links.
+        keys_q, dms_c, norms_c, _scp, pad_q = ens._prep_inputs(
+            chunk, 4, None, None)
+        dev = ens._split_packed_device(ens._run_sharded_quantized_packed_be(
             keys_q, dms_c, norms_c, ens._profiles, ens._freqs,
-            ens._chan_ids)[:3]   # drop the finite-mask guard output
+            ens._chan_ids)[0])
         if pad_q:
             dev = tuple(a[:chunk] for a in dev)
         jax.block_until_ready(dev)
@@ -1487,6 +1490,214 @@ def serve_smoke():
     return {"metric": "serve_smoke", "invariant": True, **result, "ok": True}
 
 
+_SCENARIO_STACKS = ("scintillation", "rfi", "single_pulse",
+                    "scintillation+rfi+single_pulse:powerlaw")
+
+#: engaged (non-default) parameters so overhead timings never ride a
+#: knob's do-nothing point
+_SCENARIO_BENCH_PARAMS = {
+    "scint_dnu_d_mhz": 30.0, "scint_dt_d_s": 0.4, "scint_mod": 0.9,
+    "rfi_imp_prob": 0.3, "rfi_imp_snr": 8.0,
+    "rfi_nb_prob": 0.3, "rfi_nb_snr": 5.0,
+    "sp_sigma": 0.7, "sp_alpha": 2.0, "sp_amp": 12.0,
+}
+
+
+def _scenario_params_for(stack):
+    from psrsigsim_tpu.scenarios import parse_stack
+
+    labels = stack.split("+") if isinstance(stack, str) else stack
+    names = set(parse_stack(labels).param_names())
+    return {k: v for k, v in _SCENARIO_BENCH_PARAMS.items() if k in names}
+
+
+def time_scenarios(batch=None):
+    """Config 8: scenario-engine overhead — per-effect device seconds/obs
+    vs the base pipeline on the same geometry, via the standard K-slope
+    (:func:`_timed_slope`), plus the disabled-is-free byte gate: a
+    scenario-capable build with no stack enabled must produce the EXACT
+    bytes of the pre-scenario public API."""
+    from psrsigsim_tpu.parallel import make_mesh
+    from psrsigsim_tpu.utils.rng import stage_key as _stage_key
+
+    if batch is None:
+        batch = int(os.environ.get("PSS_BENCH_SCENARIO_OBS", "64"))
+    n_dev = len(jax.devices())
+    batch += (-batch) % n_dev
+    sim, cfg, _, _, _ = build_workload(
+        nchan=64, period_s=0.00457, samprate_mhz=0.8192, sublen_s=0.5,
+        tobs_s=10.0, fcent=1400, bw=800, smean=0.009, dm=15.9)
+
+    def slope_for(scenario):
+        mesh = make_mesh((n_dev, 1))
+        ens = sim.to_ensemble(mesh=mesh, scenario=scenario)
+        idx = jnp.arange(batch)
+        dms = jnp.full((batch,), ens.dm, jnp.float32)
+        norms = jnp.full((batch,), ens.noise_norm, jnp.float32)
+        sp = ens._prep_scenario(
+            np.arange(batch),
+            _scenario_params_for(scenario) if scenario else None)
+
+        @partial(jax.jit, static_argnames=("k",))
+        def run_k(root, k):
+            def body(i, acc):
+                keys = jax.vmap(
+                    lambda j: _stage_key(jax.random.fold_in(root, i),
+                                         "user", j)
+                )(idx)
+                out = ens._run_sharded(
+                    *ens._program_args(keys, dms, norms, sp))
+                return acc + out
+            shape = (batch, ens.cfg.meta.nchan, ens.cfg.nsamp)
+            return jax.lax.fori_loop(0, k, body,
+                                     jnp.zeros(shape, jnp.float32))
+
+        def call(k, seed):
+            return run_k(jax.random.key(seed), k)
+
+        slope, _, sdiag = _timed_slope(call, 1, 9)
+        return slope / batch, sdiag
+
+    base_s, base_diag = slope_for(None)
+    effects = {}
+    slopes_ok = base_diag["slope_ok"]
+    for stack in _SCENARIO_STACKS:
+        s_obs, sdiag = slope_for(stack.split("+"))
+        effects[stack] = {
+            "tpu_s_per_obs": round(s_obs, 6),
+            "overhead_vs_base": round(s_obs / base_s - 1.0, 4),
+            "slope_ok": sdiag["slope_ok"],
+        }
+        slopes_ok = slopes_ok and sdiag["slope_ok"]
+
+    # disabled-is-free: value level (the jaxpr-level gate rides tier-1,
+    # tests/test_scenarios.py TestDisabledIsFree)
+    mesh = make_mesh((n_dev, 1))
+    legacy = sim.to_ensemble(mesh=mesh)
+    off = sim.to_ensemble(mesh=mesh, scenario=[])
+    a = [np.asarray(x) for x in legacy.run_quantized(n_dev * 2, seed=3)]
+    b = [np.asarray(x) for x in off.run_quantized(n_dev * 2, seed=3)]
+    disabled_free = all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    return {
+        "batch": batch,
+        "nchan": cfg.meta.nchan,
+        "nsub": cfg.nsub,
+        "nbin": cfg.nph,
+        "base_tpu_s_per_obs": round(base_s, 6),
+        "effects": effects,
+        "disabled_is_free": bool(disabled_free),
+        "slope_ok": slopes_ok,
+    }
+
+
+def scenario_smoke():
+    """Quick scenario-engine gate (``make bench-scenarios``): (a) the
+    disabled-is-free byte gate — a scenario-capable ensemble with no
+    stack matches the pre-scenario public API byte-for-byte; (b) per
+    registered effect, quantized bytes are BIT-identical across chunk
+    sizes and vs the one-dispatch path; (c) a scenario serve request is
+    bit-identical solo vs coalesced with strangers, and /metrics carries
+    the per-scenario traffic counters; (d) per-effect overhead vs the
+    base pipeline is REPORTED (gated only against collapse, not an
+    absolute rate)."""
+    from psrsigsim_tpu.parallel import make_mesh
+    from psrsigsim_tpu.serve import SimulationService
+
+    n_dev = len(jax.devices())
+    sim, cfg, _, _, _ = build_workload(
+        nchan=4, period_s=0.005, samprate_mhz=0.2048, sublen_s=0.5,
+        tobs_s=1.0, fcent=1400, bw=400, smean=0.05, dm=10.0)
+    mesh = make_mesh((n_dev, 1))
+    batch = int(os.environ.get("PSS_BENCH_SCENARIO_OBS", "16"))
+    batch += (-batch) % n_dev
+
+    # (a) disabled-is-free: byte identity vs the pre-scenario public API
+    legacy = sim.to_ensemble(mesh=mesh)
+    off = sim.to_ensemble(mesh=mesh, scenario=[])
+    a = [np.asarray(x) for x in legacy.run_quantized(batch, seed=3)]
+    b = [np.asarray(x) for x in off.run_quantized(batch, seed=3)]
+    assert all(np.array_equal(x, y) for x, y in zip(a, b)), (
+        "scenario-free build is NOT byte-identical to the pre-scenario "
+        "pipeline")
+
+    def _timed_run(ens, sp):
+        _touch(ens.run(batch, seed=1, scenario_params=sp)
+               if sp is not None else ens.run(batch, seed=1))  # compile
+        best = float("inf")
+        for r in range(3):
+            t0 = time.perf_counter()
+            _touch(ens.run(batch, seed=2 + r, scenario_params=sp)
+                   if sp is not None else ens.run(batch, seed=2 + r))
+            best = min(best, time.perf_counter() - t0)
+        return best / batch
+
+    base_s = _timed_run(legacy, None)
+    effects = {}
+    n_obs = 24 + (-24) % n_dev
+    for stack in _SCENARIO_STACKS:
+        ens = sim.to_ensemble(mesh=mesh, scenario=stack.split("+"))
+        sp = _scenario_params_for(stack)
+        # (d) per-effect overhead vs base, wall-clock on the smoke
+        # geometry (the K-slope version is config8 in the full bench);
+        # gated only against collapse, not an absolute rate
+        s_obs = _timed_run(ens, sp)
+        effects[stack] = {
+            "tpu_s_per_obs": round(s_obs, 6),
+            "overhead_vs_base": round(s_obs / base_s - 1.0, 4),
+        }
+        assert s_obs < 100 * base_s, (stack, s_obs, base_s)
+
+        # (b) invariance: chunked {8, n_obs} vs one dispatch
+        whole = [np.asarray(x) for x in
+                 ens.run_quantized(n_obs, seed=5, scenario_params=sp)]
+        for cs in (8, n_obs):
+            parts = [blk for _, blk in ens.iter_chunks(
+                n_obs, chunk_size=cs, seed=5, quantized=True,
+                scenario_params=sp)]
+            got = [np.concatenate([p[k] for p in parts]) for k in range(3)]
+            assert all(np.array_equal(w, g) for w, g in zip(whole, got)), (
+                f"{stack}: quantized bytes differ at chunk_size={cs}")
+    result = {
+        "batch": batch,
+        "nchan": cfg.meta.nchan,
+        "nsub": cfg.nsub,
+        "nbin": cfg.nph,
+        "base_tpu_s_per_obs": round(base_s, 6),
+        "effects": effects,
+        "disabled_is_free": True,
+    }
+
+    # (c) serving: scenario spec solo vs coalesced + traffic counters
+    spec = dict(_SERVE_BASE_SPEC, scenarios=["scintillation", "rfi"],
+                scint_mod=0.9, rfi_imp_prob=0.4)
+
+    def serve_scenario(widths, n_strangers, window):
+        svc = SimulationService(cache_dir=None, widths=widths,
+                                batch_window_s=window)
+        try:
+            ids = [svc.submit(dict(spec, seed=1000 + i))[0]
+                   for i in range(n_strangers)]
+            rid, _ = svc.submit(spec)
+            out = np.asarray(svc.result(rid, timeout=600)).tobytes()
+            for i in ids:
+                svc.result(i, timeout=600)
+            svc.registry.assert_single_compile()
+            return out, svc.metrics()
+        finally:
+            assert svc.close(), "serving engine failed to drain"
+
+    solo, _ = serve_scenario((1,), 0, 0.0)
+    co8, metrics = serve_scenario((8,), 6, 0.1)
+    assert solo == co8, (
+        "scenario serve result is NOT batching-invariant")
+    counts = metrics["scenario_requests"]
+    assert counts.get("scintillation+rfi") == 7, counts
+
+    return {"metric": "scenario_smoke", "invariant": True, **result,
+            "ok": True}
+
+
 def time_io_encode(nchan=2048, nsub=20, nbin=2048):
     """Host-side PSRFITS subint encode (float32 -> '>i2' relayout) and pdv
     text formatting: C++ fast path vs the pure-Python fallback."""
@@ -1688,6 +1899,13 @@ def main():
             result = serve_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
+    if "--scenario-smoke" in sys.argv[1:]:
+        # `make bench-scenarios`: disabled-is-free + per-effect
+        # invariance + serve scenario-batching gates, overheads reported
+        with contextlib.redirect_stdout(sys.stderr):
+            result = scenario_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
     with contextlib.redirect_stdout(sys.stderr):
         detail = _main()
     # the citable record: full detail atomically on disk, compact
@@ -1844,6 +2062,16 @@ def _main():
         f"({srv['batched_over_serial']:.2f}x; cache hits "
         f"{srv['cache_hit_req_per_sec']:.1f} req/s, p99 "
         f"{srv['request_p99_s']*1e3:.1f} ms, buckets {srv['bucket_calls']})")
+    _checkpoint(detail)
+
+    # --- config 8: scenario engine --------------------------------------
+    sc = time_scenarios()
+    detail["config8_scenarios"] = sc
+    _sc_parts = ", ".join(
+        f"{name}: +{eff['overhead_vs_base']*100:.1f}%"
+        for name, eff in sc["effects"].items())
+    log(f"config8_scenarios: base {1/sc['base_tpu_s_per_obs']:.1f} obs/s; "
+        f"overhead {_sc_parts}; disabled_is_free={sc['disabled_is_free']}")
     _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------

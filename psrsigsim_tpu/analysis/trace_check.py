@@ -86,6 +86,22 @@ def _specs():
                             jnp.asarray([0.01, 0.02, 0.01], f))),
         "fixed_histogram": (lambda x: ops.fixed_histogram(x, -1.0, 1.0, 8),
                             (block[0],)),
+        "scint_gain": (lambda k, fr, dnu, dt, m: ops.scint_gain(
+            k, fr, 4, dnu, dt, m, 1400.0, 0.5),
+            (key, jnp.linspace(1200.0, 1600.0, 3, dtype=f),
+             jnp.asarray(20.0, f), jnp.asarray(0.5, f),
+             jnp.asarray(1.0, f))),
+        "rfi_levels": (lambda k, c, ip, ia, np_, na: ops.rfi_levels(
+            k, c, 4, ip, ia, np_, na),
+            (key, jnp.arange(3), jnp.asarray(0.5, f), jnp.asarray(5.0, f),
+             jnp.asarray(0.5, f), jnp.asarray(3.0, f))),
+        # static mode choice: every mode is its own program; the probe
+        # covers the symbol once per mode so a trace-unsafe edit to any
+        # branch fails here
+        "pulse_energies": (lambda k, s: tuple(
+            ops.pulse_energies(k, 4, mode, s)
+            for mode in ("lognormal", "powerlaw", "frb")),
+            (key, jnp.asarray(0.5, f))),
         "block_downsample": (lambda d: ops.block_downsample(d, 4), (block,)),
         "rebin": (lambda d: ops.rebin(d, 16), (block,)),
         "clip_cast": (lambda b: ops.clip_cast(b, 200.0), (block,)),

@@ -71,6 +71,9 @@ _FINGERPRINT_HINTS = {
     "ref_MJD": "polyco reference epoch differs",
     "obs_per_file": "file packing differs — files would interleave "
                     "incompatibly",
+    "scenario": "scenario-effect stack differs — same out_dir, different "
+                "physics",
+    "scenario_params_sha256": "scenario parameter content differs",
 }
 
 
@@ -785,12 +788,13 @@ def _template_sha(tmpl):
 
 
 def _manifest_fingerprint(n_obs, seed, dms, noise_norms, tmpl, parfile,
-                          MJD_start, ref_MJD, obs_per_file=1):
+                          MJD_start, ref_MJD, obs_per_file=1,
+                          scenario=None, scenario_params=None):
     # the template is fingerprinted by CONTENT, so str-path and FitsFile
     # callers of the same file agree and a swapped template is caught on
     # resume
     tmpl_sha = _template_sha(tmpl)
-    return {
+    fp = {
         "n_obs": int(n_obs),
         "seed": int(seed),
         "dms_sha256": _array_sha(dms),
@@ -801,6 +805,30 @@ def _manifest_fingerprint(n_obs, seed, dms, noise_norms, tmpl, parfile,
         "ref_MJD": float(ref_MJD),
         "obs_per_file": int(obs_per_file),
     }
+    if scenario is not None:
+        # only stamped for scenario exports, so pre-scenario out_dirs
+        # keep resuming under their old manifests; resuming a scenario
+        # export with different effects/parameters is refused loudly
+        from ..scenarios.registry import _param
+
+        fp["scenario"] = "+".join(scenario.labels())
+        canon = {}
+        for name in scenario.param_names():
+            # hash the RESOLVED value, not "unset": passing a knob's
+            # registry default explicitly must hash like omitting it
+            # (identical bytes), and a future default change must refuse
+            # to resume an old out_dir (different bytes) — both fall out
+            # of canonicalizing to the value _prep_scenario actually uses
+            v = (scenario_params or {}).get(name)
+            if v is None:
+                canon[name] = float(_param(name).default)
+            elif np.ndim(v) == 0:
+                canon[name] = float(v)
+            else:
+                canon[name] = [float(x) for x in np.ravel(v)]
+        fp["scenario_params_sha256"] = hashlib.sha256(
+            json.dumps(canon, sort_keys=True).encode()).hexdigest()
+    return fp
 
 
 def _load_manifest(out_dir):
@@ -950,7 +978,7 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                             ref_MJD=56000.0, writers=None,
                             obs_per_file=1, supervisor=None, faults=None,
                             pipeline_depth=2, telemetry=None,
-                            manifest_extra=None):
+                            manifest_extra=None, scenario_params=None):
     """Export ``n_obs`` ensemble observations as PSRFITS files.
 
     Args:
@@ -1061,7 +1089,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
 
     fp = _manifest_fingerprint(
         n_obs, seed, dms, noise_norms, tmpl, parfile, MJD_start, ref_MJD,
-        obs_per_file)
+        obs_per_file, scenario=getattr(ens, "scenario", None),
+        scenario_params=scenario_params)
     _check_manifest(out_dir, fp, resume)
     if manifest_extra:
         clash = set(manifest_extra) & set(fp)
@@ -1194,18 +1223,29 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         if commit is not None:
             commit(token, results)
 
+    # the scenario engine's ground-truth RFI mask rides the same fused
+    # mask transport as the finite guard; supervised scenario exports
+    # journal per-observation contamination as provenance (PR-2 journal
+    # discipline — fsync'd, resume-stable)
+    want_rfi = supervisor is not None and getattr(ens, "_has_rfi", False)
+
     ok = False
     try:
         for start, block in ens.iter_chunks(
             n_obs, chunk_size=chunk_size, seed=seed, dms=dms,
             noise_norms=norms_main, quantized=True, progress=progress,
             skip_chunk=skip, byte_order="big",
-            finite_mask=supervisor is not None,
+            finite_mask=supervisor is not None, rfi_mask=want_rfi,
+            scenario_params=scenario_params,
             prefetch=max(1, pipeline_depth), fetch_ahead=pipeline_depth,
             timers=telemetry,
         ):
             if supervisor is not None:
-                data, scl, offs, finite = block
+                if want_rfi:
+                    data, scl, offs, finite, rfi = block
+                    supervisor.observe_rfi(start, np.asarray(rfi))
+                else:
+                    data, scl, offs, finite = block
                 # the fused in-graph guard: one small bool host array per
                 # chunk, never a per-observation round-trip
                 bad_obs |= supervisor.observe_chunk(
@@ -1280,7 +1320,7 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     if supervisor is not None and bad_obs:
         _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
                            n_obs, seed, dms, noise_norms, obs_per_file,
-                           dms_np)
+                           dms_np, scenario_params)
 
     # fold the run's stage telemetry into the manifest so every export
     # names its own bottleneck (supervisor.finalize preserves the key).
@@ -1299,7 +1339,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
 
 
 def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
-                       n_obs, seed, dms, noise_norms, obs_per_file, dms_np):
+                       n_obs, seed, dms, noise_norms, obs_per_file, dms_np,
+                       scenario_params=None):
     """Re-run every quarantined observation ONCE with a fresh fold of its
     PRNG key (clean inputs — injection poisons the main pass only), write
     the files whose observations all came back finite, and record the
@@ -1310,11 +1351,18 @@ def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
     export; only the re-drawn observations differ (and are journaled)."""
     salt = supervisor.retry_fold_salt
     groups = sorted({i // obs_per_file for i in bad_obs})
+    want_rfi = getattr(ens, "_has_rfi", False)
     if not supervisor.retry_enabled:
         for g in groups:
             first, end = packer.group_span(g)
             bad = [i for i in range(first, end) if i in bad_obs]
             supervisor.record_retry(g, [], bad)
+            if want_rfi:
+                # the group's file is never written: drop the main
+                # pass's RFI truth for EVERY member so the manifest's
+                # provenance only counts observations in the dataset
+                # (a later resume re-observes the delivered bytes)
+                supervisor.observe_rfi_retry(list(range(first, end)), None)
         return
     # at most TWO device dispatches regardless of how many groups are
     # affected (each distinct batch width is a fresh XLA compile): one
@@ -1328,14 +1376,17 @@ def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
     if all_good:
         dg, sg, og, _ = ens.run_quantized_at(
             all_good, seed=seed, dms=dms, noise_norms=noise_norms,
-            byte_order="big")
+            byte_order="big", scenario_params=scenario_params)
         dg, sg, og = (np.asarray(a) for a in (dg, sg, og))
         for k, i in enumerate(all_good):
             parts[i] = (dg[k], sg[k], og[k])
-    db, sb, ob, mb = ens.run_quantized_at(
+    out_bad = ens.run_quantized_at(
         all_bad, seed=seed, dms=dms, noise_norms=noise_norms,
-        byte_order="big", fold_salt=salt)
-    db, sb, ob, mb = (np.asarray(a) for a in (db, sb, ob, mb))
+        byte_order="big", fold_salt=salt, scenario_params=scenario_params,
+        return_rfi=want_rfi)
+    db, sb, ob, mb = (np.asarray(a) for a in out_bad[:4])
+    rfi_bad = np.asarray(out_bad[4]) if want_rfi else None
+    pos = {i: k for k, i in enumerate(all_bad)}
     healed = {}
     for k, i in enumerate(all_bad):
         if mb[k].all():
@@ -1345,6 +1396,17 @@ def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
         members = list(range(first, end))
         bad = [i for i in members if i in bad_obs]
         still_bad = [i for i in bad if i not in healed]
+        if want_rfi:
+            # follow the bytes actually delivered: a group with a
+            # still-bad member writes NO file, so drop the RFI truth
+            # for every member (a later resume re-observes its fresh
+            # attempt); a fully-healed group ships the salted re-fold's
+            # FRESH realization for its bad members, so overwrite theirs
+            if still_bad:
+                supervisor.observe_rfi_retry(members, None)
+            elif bad:
+                supervisor.observe_rfi_retry(
+                    bad, np.stack([rfi_bad[pos[i]] for i in bad]))
         supervisor.record_retry(g, bad, still_bad)
         if still_bad:
             # the group's file is NOT written; the manifest records the

@@ -17,7 +17,10 @@ arrival, like TEMPO/PINT.  Supported components:
   motion, parallax (annual curvature term);
 - spin: any number of frequency derivatives F0..Fn;
 - dispersion: DM + DM1/DM2 polynomial + piecewise DMX ranges + FD terms;
-- binary: BT, DD, DDS, DDK, ELL1, ELL1H via an exact Kepler solve
+- binary: BT, DD, DDS, DDK, ELL1, ELL1H via an exact Kepler solve;
+  orbital frequency either as PB/PBDOT or as the FB-series Taylor
+  expansion FB0..FBn (the BTX-style parameterization black-widow pulsars
+  are fit with — evaluated directly as orbital phase)
   (ELL1 eccentric parameters are converted to e/omega/T0, which is the
   exact form of the same orbit; DDK's Kopeikin annual-orbital-parallax
   corrections to x and omega are ~us-level and deliberately omitted);
@@ -51,11 +54,12 @@ _PC_LTS = 3.0856775814913673e16 / 299792458.0  # parsec in light-seconds
 
 class UnsupportedTimingModelError(ValueError):
     """The par file carries timing-model terms this model cannot honor
-    (orbital-frequency series FB1+, TCB units, unknown binary models,
-    unknown glitch-family or site codes).  The reference handles arbitrary
-    models through PINT (reference: io/psrfits.py:144-177); here
-    unsupported terms must be rejected loudly rather than silently
-    ignored."""
+    (TCB units, unknown binary models, unknown glitch-family or site
+    codes).  The reference handles arbitrary models through PINT
+    (reference: io/psrfits.py:144-177); here unsupported terms must be
+    rejected loudly rather than silently ignored.  (FB-series
+    orbital-frequency derivatives, rejected through round 5, are now
+    evaluated directly — see :meth:`TimingModel._binary_delay_at`.)"""
 
 
 # multi-line flagged terms (noise/jump descriptors) collected as lists by
@@ -116,9 +120,11 @@ def _parse_value(key, val):
 
 def check_model_supported(params, parfile="<par>"):
     """Raise :class:`UnsupportedTimingModelError` for terms that would be
-    silently mispredicted: FB1+ orbital-frequency derivatives, TCB units,
-    unknown binary models, unknown glitch-family terms, incomplete glitch
-    groups, unknown observatory codes."""
+    silently mispredicted: TCB units, unknown binary models, unknown
+    glitch-family terms, incomplete glitch groups, unknown observatory
+    codes.  FB-series orbital-frequency derivatives (FB0..FBn) are
+    implemented (``_init_binary``/``_binary_delay_at``) — an FBn without
+    a BINARY model is still an orphan, caught below."""
     bad = []
     glitch_idx = set()
     for key, val in params.items():
@@ -130,9 +136,6 @@ def check_model_supported(params, parfile="<par>"):
             glitch_idx.add(m.group(2))
         elif kb.startswith("GL"):
             bad.append(key)  # unknown glitch-family term
-        elif re.match(r"^FB[1-9]\d*$", kb):
-            if isinstance(val, (float, np.floating)) and val != 0.0:
-                bad.append(key)
     for idx in sorted(glitch_idx):
         if f"GLEP_{idx}" not in params:
             bad.append(f"GLF*_{idx} (without GLEP_{idx})")
@@ -158,10 +161,12 @@ def check_model_supported(params, parfile="<par>"):
     if not binary:
         # orbital parameters without a BINARY model would be silently
         # dropped — reject them instead
-        orphans = [k for k in ("PB", "A1", "T0", "TASC", "EPS1", "EPS2")
-                   if isinstance(params.get(k), (float, np.floating))
+        orphans = [k for k in params
+                   if (k in ("PB", "A1", "T0", "TASC", "EPS1", "EPS2")
+                       or re.match(r"^FB\d+$", k))
+                   and isinstance(params.get(k), (float, np.floating))
                    and params[k] != 0.0]
-        bad.extend(orphans)
+        bad.extend(sorted(orphans))
     site = str(params.get("TZRSITE", "@")).strip().lower()
     if site not in ephem.BARYCENTRIC_SITES:
         try:
@@ -364,6 +369,25 @@ class TimingModel:
     def _init_binary(self, p):
         b = self.binary
         self._h3_only = 0.0
+        # FB-series orbital-frequency derivatives (TEMPO2/PINT's BTX-style
+        # parameterization, standard for black-widow systems whose orbital
+        # period wanders non-linearly): orbital phase is evaluated as the
+        # Taylor series  nb(t) = Σ_k FBk · dt^(k+1)/(k+1)!  [dt in s]
+        # directly, superseding the PB/PBDOT form.  Engaged only when a
+        # nonzero FB1+ term is present, so FB0-only and PB par files keep
+        # their exact round-5 arithmetic.
+        fbs = {}
+        for key, val in p.items():
+            m = re.match(r"^FB(\d+)$", key)
+            if m and isinstance(val, (float, np.floating)):
+                fbs[int(m.group(1))] = float(val)
+        self.fb_terms = None
+        if fbs and any(v != 0.0 for i, v in fbs.items() if i >= 1):
+            if fbs.get(0, 0.0) == 0.0:
+                raise ValueError(
+                    f"binary model {b} has FB1+ derivatives without FB0")
+            nmax = max(fbs)
+            self.fb_terms = [fbs.get(i, 0.0) for i in range(nmax + 1)]
         if "PB" in p:
             self.pb = float(p["PB"])  # days
         elif "FB0" in p:
@@ -462,8 +486,18 @@ class TimingModel:
     def _binary_delay_at(self, t_mjd):
         dt_days = np.asarray(t_mjd - self.t0, np.float64)
         dt_sec = dt_days * _SEC_PER_DAY
-        nb = dt_days / self.pb  # orbits since T0
-        m_anom = 2.0 * np.pi * (nb - 0.5 * self.pbdot * nb * nb)
+        if self.fb_terms is not None:
+            # orbital phase from the FB Taylor series (orbits since T0):
+            # nb = FB0·dt + FB1·dt²/2! + FB2·dt³/3! + ...  — Horner form
+            # in dt, factorials folded into the running coefficient
+            nb = np.zeros(np.shape(dt_sec))
+            for k in range(len(self.fb_terms) - 1, -1, -1):
+                nb = (nb * dt_sec / (k + 2)) + self.fb_terms[k]
+            nb = nb * dt_sec
+            m_anom = 2.0 * np.pi * nb
+        else:
+            nb = dt_days / self.pb  # orbits since T0
+            m_anom = 2.0 * np.pi * (nb - 0.5 * self.pbdot * nb * nb)
         ecc = np.clip(self.ecc + self.edot * dt_sec, 0.0, 0.999999)
         x = self.a1 + self.xdot * dt_sec
         om = self.om0 + self.omdot * dt_days

@@ -51,6 +51,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.stats import fixed_histogram
 from ..ops.toa import fftfit_combine, fftfit_shift
 from ..parallel.mesh import CHAN_AXIS, OBS_AXIS, make_mesh
+from ..scenarios.registry import (apply_additive_effects,
+                                  apply_pulse_effects,
+                                  scenario_knobs as _scenario_knobs,
+                                  stack_from_knobs)
 from ..simulate.pipeline import _chan_chi2, _dispersion_delays
 from ..utils.rng import stage_key
 from .priors import Prior, parse_prior
@@ -83,7 +87,19 @@ _TRIALS_RAW = "trials.f32"
 #:                  knob; noise_norm scales linearly with T_sys).
 #: ``null_frac``    per-subint nulling probability: nulled subints carry
 #:                  only radiometer noise.
-KNOBS = ("dm", "tau_d_ms", "width", "amp", "noise_scale", "null_frac")
+#:
+#: Every parameter registered with the scenario engine
+#: (:mod:`psrsigsim_tpu.scenarios`) is ALSO a knob, appended after the
+#: base six in registry order (appending keeps existing studies' prior
+#: key-fold slots stable): ``scint_*`` knobs enable the scintillation
+#: gain screen, ``rfi_*`` knobs enable RFI injection, and exactly one of
+#: ``sp_sigma``/``sp_alpha``/``sp_amp`` enables single-pulse emission in
+#: log-normal / power-law / FRB one-off mode.  The static effect stack
+#: is inferred from which knobs carry priors
+#: (:func:`psrsigsim_tpu.scenarios.stack_from_knobs`); unsampled
+#: parameters of an enabled effect take registry defaults.
+KNOBS = (("dm", "tau_d_ms", "width", "amp", "noise_scale", "null_frac")
+         + _scenario_knobs())
 
 #: derived per-trial metrics appended after the sampled parameters:
 #: inverse-variance-combined TOA residual (turns, after subtracting the
@@ -213,6 +229,11 @@ class MonteCarloStudy:
         self.param_names = tuple(k for k in KNOBS if k in priors)
         self.priors = {k: priors[k] for k in self.param_names}
         self.metric_names = self.param_names + DERIVED_METRICS
+        # STATIC scenario stack inferred from the declared priors (any
+        # scint_*/rfi_* knob, exactly one sp_* mode selector); None
+        # compiles the scenario-free trial program bit-identically to a
+        # pre-scenario build
+        self._scenario = stack_from_knobs(self.param_names)
 
         if getattr(cfg, "shift_mode", "envelope") != "envelope":
             # the trial body mirrors _fold_core's ENVELOPE branch only; a
@@ -333,6 +354,19 @@ class MonteCarloStudy:
         block = jnp.tile(shifted, (1, cfg.nsub))
         block = block * _chan_chi2(kp, chan_ids, cfg.nfold, nsamp) \
             * cfg.draw_norm
+        if self._scenario is not None:
+            # multiplicative scenario effects (scintillation gains,
+            # single-pulse energies) land before nulling/noise — the
+            # SAME registry hooks, stage keys, and op order as
+            # simulate.pipeline._fold_core, so a trial and a pipeline
+            # observation of one scenario are bit-identical (pinned by
+            # tests/test_scenarios.py); unsampled parameters of an
+            # enabled effect take registry defaults inside param_dict
+            block = apply_pulse_effects(
+                key, block, self._scenario, p, nsub=cfg.nsub,
+                nph=cfg.nph, freqs=freqs, fcent_mhz=cfg.meta.fcent_mhz,
+                sublen_s=cfg.nfold * cfg.period_s,
+                f_lo_mhz=cfg.meta.fcent_mhz - cfg.meta.bw_mhz / 2)
         if "null_frac" in p:
             ksel = stage_key(key, "null_select")
             u = jax.random.uniform(ksel, (cfg.nsub,), jnp.float32)
@@ -342,6 +376,13 @@ class MonteCarloStudy:
         nn = jnp.float32(self.noise_norm) * p.get("noise_scale",
                                                   jnp.float32(1.0))
         block = block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * nn
+        if self._scenario is not None:
+            # additive effects (RFI) ride on top of the radiometer term,
+            # scaled by this trial's OWN mean noise level
+            block = apply_additive_effects(
+                key, block, self._scenario, p, nsub=cfg.nsub,
+                nph=cfg.nph, chan_ids=chan_ids,
+                noise_level=cfg.noise_df * nn)
         return block, delays_ms, prof, p
 
     def _trial_metrics(self, key, idx, profiles, freqs, chan_ids):
@@ -436,7 +477,7 @@ class MonteCarloStudy:
         writer knobs are deliberately absent, they cannot change the
         bytes)."""
         cfg = self.cfg
-        return {
+        fp = {
             "kind": "mc_study",
             "n_trials": int(n_trials),
             "seed": int(self.seed),
@@ -465,6 +506,23 @@ class MonteCarloStudy:
                     self._profiles_np.tobytes()).hexdigest(),
             },
         }
+        if self._scenario is not None:
+            # only stamped when a scenario is active, so pre-scenario
+            # sweep directories keep resuming under their old manifests
+            fp["scenarios"] = self._scenario.describe()
+            # prior-less knobs of an enabled effect take REGISTRY
+            # defaults inside the trial program (registry.param_dict):
+            # stamp the resolved values so a future default change
+            # refuses to resume an old sweep dir instead of silently
+            # producing different trial bytes (same contract as
+            # io/export's scenario_params_sha256)
+            from ..scenarios.registry import _param
+
+            fp["scenario_defaults"] = {
+                n: float(_param(n).default)
+                for n in self._scenario.param_names()
+                if n not in self.priors}
+        return fp
 
     @staticmethod
     def _check_manifest(out_dir, fp, resume):

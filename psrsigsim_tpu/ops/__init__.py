@@ -10,6 +10,7 @@ from .convolve import convolve_profiles, fft_convolve_full
 from .interp import PchipCoeffs, pchip_eval, pchip_fit, pchip_slopes
 from .quantize import clip_cast, subint_dequantize, subint_quantize, swap16
 from .resample import block_downsample, rebin
+from .scenario import pulse_energies, rfi_levels, scint_gain
 from .shift import (
     coherent_dedisperse,
     coherent_dedispersion_transfer,
@@ -40,6 +41,9 @@ __all__ = [
     "fftfit_batch",
     "fftfit_combine",
     "fixed_histogram",
+    "scint_gain",
+    "rfi_levels",
+    "pulse_energies",
     "block_downsample",
     "rebin",
     "clip_cast",

@@ -1,0 +1,205 @@
+"""In-graph scenario physics: scintillation screens, RFI, pulse energies.
+
+These are the device kernels behind :mod:`psrsigsim_tpu.scenarios` — the
+registry that makes each effect reachable from the ensemble API, the
+Monte-Carlo study engine, and the serving layer.  Like every op in this
+package they are pure, take plain arrays plus static Python config, and
+compose under jit/vmap/shard_map.
+
+Reproducibility contract (shared with the pipelines, DIVERGENCES #18):
+every draw is keyed by integers that are GLOBAL to the observation —
+scintle cell ids, global channel ids, subint ids — folded off a key the
+caller has already staged per (observation, effect).  Consequently the
+same observation produces bit-identical effect realizations under any
+mesh shape, channel split, batch width, or serving bucket width.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["scint_gain", "rfi_levels", "pulse_energies",
+           "SCINT_DNU_EXPONENT", "SCINT_DT_EXPONENT", "SP_MODES"]
+
+# Thin-screen Kolmogorov scaling exponents (beta = 11/3 < 4 branches of
+# models/ism ISM.scale_dnu_d / scale_dt_d — Stinebring & Condon 1990):
+# dnu_d ∝ nu^(2β/(β-2)) = nu^4.4 and dt_d ∝ nu^(2/(β-2)) = nu^1.2.
+SCINT_DNU_EXPONENT = 4.4
+SCINT_DT_EXPONENT = 1.2
+
+#: single-pulse energy-distribution modes (static trace-time choice)
+SP_MODES = ("lognormal", "powerlaw", "frb")
+
+# scintle cell ids are clipped into this range before the key fold so a
+# degenerate dnu_d/dt_d (→ inf cells) can never overflow the int32 fold
+_MAX_CELL = jnp.int32(1 << 24)
+
+
+def _cell_clip(x):
+    return jnp.clip(jnp.floor(x), 0, _MAX_CELL).astype(jnp.int32)
+
+
+def scint_gain(key, freqs_mhz, nsub, dnu_d_mhz, dt_d_s, mod_index,
+               fcent_mhz, sublen_s, f_lo_mhz=None):
+    """Dynamic-spectrum scintillation gain screen, ``(Nchan, nsub)``.
+
+    Models strong (saturated) scintillation: the band/time plane is
+    tiled into scintles of bandwidth ``dnu_d(f)`` and timescale
+    ``dt_d(f)`` following the thin-screen Kolmogorov scalings of
+    :meth:`psrsigsim_tpu.models.ism.ISM.scale_dnu_d` /
+    :meth:`~psrsigsim_tpu.models.ism.ISM.scale_dt_d` (``nu^4.4`` /
+    ``nu^1.2`` referenced to ``fcent_mhz``), and every scintle carries
+    one unit-mean exponential intensity gain — the point-source strong-
+    scintillation statistic.  ``mod_index`` in [0, 1] interpolates from
+    no modulation (0) to fully saturated (1): ``g = 1 + m (e - 1)``.
+
+    Draw keying is by SCINTLE CELL, not by channel/subint: two channels
+    inside one scintle fold the same cell ids and therefore draw the
+    SAME gain — correlation comes for free from the keying, with no
+    interpolation step — and results are invariant to channel sharding
+    and batch shape because cell ids derive only from frequencies and
+    times.
+
+    Args:
+        key: the observation's scintillation stage key (caller stages
+            ``stage_key(obs_key, "scint")``).
+        freqs_mhz: channel frequencies, ``(Nchan,)`` (traced).
+        nsub: number of subintegrations (static).
+        dnu_d_mhz: scintillation bandwidth at ``fcent_mhz`` (traced).
+        dt_d_s: scintillation timescale at ``fcent_mhz`` (traced).
+        mod_index: modulation index in [0, 1] (traced).
+        fcent_mhz: reference frequency (static or traced).
+        sublen_s: subintegration length in seconds (static or traced).
+        f_lo_mhz: the GLOBAL band floor the frequency-cell integral
+            anchors at.  Pass the full band's lowest channel frequency
+            whenever ``freqs_mhz`` might be a shard slab — deriving the
+            floor from the passed channels would give each channel shard
+            its own cell origin and break mesh-shape invariance.
+            ``None`` (single-device convenience) uses ``min(freqs_mhz)``.
+
+    Returns:
+        ``(Nchan, nsub)`` float32 gains, unit mean per scintle cell.
+    """
+    f = jnp.asarray(freqs_mhz, jnp.float32)
+    x = f / jnp.float32(fcent_mhz)                    # O(1) band coordinate
+    dnu = jnp.maximum(jnp.float32(dnu_d_mhz), 1e-6)
+    dt = jnp.maximum(jnp.float32(dt_d_s), 1e-6)
+
+    # frequency cells: the integrated scintle count from the band floor,
+    # N(f) = ∫_{x_lo}^{x} (fcent/dnu_d) x'^-4.4 dx' — closed form, so the
+    # cell id is a pure function of frequency (channel-shard invariant)
+    if f_lo_mhz is None:
+        f_lo_mhz = jnp.min(f)
+    x_lo = jnp.asarray(f_lo_mhz, jnp.float32) / jnp.float32(fcent_mhz)
+    a = jnp.float32(SCINT_DNU_EXPONENT - 1.0)         # 3.4
+    n_f = (jnp.float32(fcent_mhz) / dnu) * (x_lo ** -a - x ** -a) / a
+    cell_f = _cell_clip(n_f)                          # (Nchan,)
+
+    # time cells: subint midpoints over the per-channel timescale
+    t_mid = (jnp.arange(nsub, dtype=jnp.float32) + 0.5) * jnp.float32(sublen_s)
+    dt_c = dt * x ** jnp.float32(SCINT_DT_EXPONENT)   # (Nchan,)
+    cell_t = _cell_clip(t_mid[None, :] / dt_c[:, None])   # (Nchan, nsub)
+
+    def per_chan(cf, ct_row):
+        kc = jax.random.fold_in(key, cf)
+        return jax.vmap(
+            lambda ct: jax.random.exponential(
+                jax.random.fold_in(kc, ct), dtype=jnp.float32)
+        )(ct_row)
+
+    g = jax.vmap(per_chan)(cell_f, cell_t)            # (Nchan, nsub)
+    m = jnp.clip(jnp.asarray(mod_index, jnp.float32), 0.0, 1.0)
+    return 1.0 + m * (g - 1.0)
+
+
+def rfi_levels(key, chan_ids, nsub, imp_prob, imp_snr, nb_prob, nb_snr):
+    """RFI injection plan for one observation: additive levels + truth mask.
+
+    Two populations, both drawn from the observation's RFI stage key so
+    the realization is a pure function of (observation, parameters):
+
+    * **impulsive** — each subintegration independently hosts a
+      broadband burst with probability ``imp_prob``; a burst adds
+      ``imp_snr`` × (one exponential energy draw) × the mean radiometer
+      level across EVERY channel of that subint (the caller multiplies
+      by its noise level).  The burst set is shared across channels
+      (drawn from the un-folded stage key), mirroring how the nulling
+      mask is shared — identical under any channel split.
+    * **narrowband** — each channel independently carries a persistent
+      tone with probability ``nb_prob`` at ``nb_snr`` × (per-channel
+      exponential energy) × the mean radiometer level, constant in
+      time.  Tones are keyed by GLOBAL channel id.
+
+    Args:
+        key: the observation's RFI stage key.
+        chan_ids: GLOBAL channel indices ``(Nchan,)`` matching the
+            caller's channel axis (the sharding-invariance handle).
+        nsub: number of subintegrations (static).
+        imp_prob, imp_snr, nb_prob, nb_snr: traced scalars.
+
+    Returns:
+        ``(levels, mask)``: ``(Nchan, nsub)`` float32 additive levels in
+        units of the caller's mean noise level, and the ``(Nchan, nsub)``
+        bool ground-truth contamination mask (True = RFI present).
+    """
+    k_imp = jax.random.fold_in(key, 0)
+    k_nb = jax.random.fold_in(key, 1)
+
+    k_imp_sel = jax.random.fold_in(k_imp, 0)
+    k_imp_amp = jax.random.fold_in(k_imp, 1)
+    u_s = jax.random.uniform(k_imp_sel, (int(nsub),), jnp.float32)
+    burst = u_s < jnp.asarray(imp_prob, jnp.float32)          # (nsub,)
+    e_s = jax.random.exponential(k_imp_amp, (int(nsub),), jnp.float32)
+
+    def per_chan(c):
+        kc = jax.random.fold_in(k_nb, c)
+        kc_sel = jax.random.fold_in(kc, 0)
+        kc_amp = jax.random.fold_in(kc, 1)
+        u = jax.random.uniform(kc_sel, (), jnp.float32)
+        e = jax.random.exponential(kc_amp, dtype=jnp.float32)
+        return u, e
+
+    u_c, e_c = jax.vmap(per_chan)(jnp.asarray(chan_ids))      # (Nchan,)
+    tone = u_c < jnp.asarray(nb_prob, jnp.float32)
+
+    imp_lvl = jnp.asarray(imp_snr, jnp.float32) * e_s * burst
+    nb_lvl = jnp.asarray(nb_snr, jnp.float32) * e_c * tone
+    levels = imp_lvl[None, :] + nb_lvl[:, None]               # (Nchan, nsub)
+    mask = burst[None, :] | tone[:, None]
+    return levels, mask
+
+
+def pulse_energies(key, nsub, mode, param):
+    """Per-pulse (per-subintegration) energy factors, ``(nsub,)`` float32.
+
+    The single-pulse/transient emission knob: the fold envelope of
+    subint ``s`` is multiplied by ``E_s``.  ``mode`` is a STATIC choice
+    from :data:`SP_MODES`; ``param`` is the mode's one traced parameter:
+
+    * ``"lognormal"`` — ``E = exp(sigma z - sigma²/2)``, ``z ~ N(0,1)``:
+      unit-mean log-normal pulse-energy distribution (``param`` =
+      sigma, the log-energy width; giant-pulse-free moders).
+    * ``"powerlaw"`` — unit-mean Pareto: ``E = u^(-1/alpha) (alpha-1)/
+      alpha`` with ``u ~ U(0,1)`` (``param`` = alpha > 1, clipped to
+      1.05; the giant-pulse tail).
+    * ``"frb"`` — one-off transient: a single uniformly-drawn subint
+      carries energy ``param`` (amplitude, in envelope units), every
+      other subint emits NOTHING — the FRB-like appear-once scenario.
+    """
+    n = int(nsub)
+    if mode == "lognormal":
+        s = jnp.asarray(param, jnp.float32)
+        z = jax.random.normal(key, (n,), jnp.float32)
+        return jnp.exp(s * z - 0.5 * s * s)
+    if mode == "powerlaw":
+        a = jnp.maximum(jnp.asarray(param, jnp.float32), 1.05)
+        u = jax.random.uniform(key, (n,), jnp.float32,
+                               minval=1e-7, maxval=1.0)
+        return u ** (-1.0 / a) * (a - 1.0) / a
+    if mode == "frb":
+        j = jax.random.randint(key, (), 0, n)
+        onehot = (jnp.arange(n) == j).astype(jnp.float32)
+        return jnp.asarray(param, jnp.float32) * onehot
+    raise ValueError(
+        f"unknown single-pulse mode {mode!r}; valid modes: {SP_MODES}")

@@ -36,7 +36,7 @@ except AttributeError:  # pragma: no cover
 __all__ = ["FoldEnsemble", "MultiPulsarFoldEnsemble", "build_width_bucket_fn"]
 
 
-def build_width_bucket_fn(cfg, profiles):
+def build_width_bucket_fn(cfg, profiles, scenario=None):
     """The serving layer's width-bucketed batch entry: a pure function
 
         fn(keys, dms, norms, null_fracs) -> (B, Nchan, Nph) float32
@@ -46,6 +46,17 @@ def build_width_bucket_fn(cfg, profiles):
     observation to its folded pulse profile (sum over subintegrations —
     the standard served data product, :meth:`FoldEnsemble.folded_profiles`
     semantics in-graph).
+
+    With a ``scenario`` stack (a
+    :class:`~psrsigsim_tpu.scenarios.ScenarioStack`; the serving layer's
+    ``"scenarios"`` geometry field) the function grows one traced input:
+
+        fn(keys, dms, norms, null_fracs, sc) -> (B, Nchan, Nph)
+
+    where ``sc`` is the ``(B, n_params)`` per-request scenario parameter
+    matrix ordered by ``scenario.param_names()``.  Scenario-free
+    geometries compile EXACTLY the pre-scenario program (disabled is
+    free).
 
     The function is width-agnostic at trace time;
     :class:`psrsigsim_tpu.serve.ProgramRegistry` AOT-compiles it once per
@@ -60,16 +71,28 @@ def build_width_bucket_fn(cfg, profiles):
     chan_ids = jnp.arange(cfg.meta.nchan)
     nchan, nsub, nph = cfg.meta.nchan, cfg.nsub, cfg.nph
 
-    def _batch(keys, dms, norms, null_fracs):
+    if scenario is None:
+        def _batch(keys, dms, norms, null_fracs):
+            out = jax.vmap(
+                lambda k, d, n, nf: fold_pipeline(
+                    k, d, n, prof, cfg, freqs=freqs, chan_ids=chan_ids,
+                    null_frac=nf)
+            )(keys, dms, norms, null_fracs)
+            b = out.shape[0]
+            return out.reshape(b, nchan, nsub, nph).sum(axis=2)
+
+        return _batch
+
+    def _batch_scenario(keys, dms, norms, null_fracs, sc):
         out = jax.vmap(
-            lambda k, d, n, nf: fold_pipeline(
+            lambda k, d, n, nf, sp: fold_pipeline(
                 k, d, n, prof, cfg, freqs=freqs, chan_ids=chan_ids,
-                null_frac=nf)
-        )(keys, dms, norms, null_fracs)
+                null_frac=nf, scenario=scenario, scenario_params=sp)
+        )(keys, dms, norms, null_fracs, sc)
         b = out.shape[0]
         return out.reshape(b, nchan, nsub, nph).sum(axis=2)
 
-    return _batch
+    return _batch_scenario
 
 
 def _split_packed_chunk(packed, nbin):
@@ -127,10 +150,19 @@ class FoldEnsemble:
     >>> data = ens.run(n_obs=1024, seed=0, dms=dm_array)   # (1024, Nchan, Nsamp)
     """
 
-    def __init__(self, signal, pulsar, telescope, system, Tsys=None, mesh=None):
+    def __init__(self, signal, pulsar, telescope, system, Tsys=None, mesh=None,
+                 scenario=None):
+        from ..scenarios.registry import parse_stack
+
         self.cfg, profiles_np, self.noise_norm = build_fold_config(
             signal, pulsar, telescope, system, Tsys=Tsys
         )
+        # STATIC scenario stack (see psrsigsim_tpu.scenarios): None keeps
+        # every compiled program bit-identical to a scenario-free build;
+        # a stack threads one extra traced (B, n_params) input through
+        # every program and (with RFI) appends the in-graph ground-truth
+        # contamination mask to the quantized outputs
+        self.scenario = parse_stack(scenario)
         # kept for metadata-only consumers (PSRFITS export); the builder
         # above has already stamped nsub/nsamp/draw_norm onto it
         self._signal = signal
@@ -156,32 +188,60 @@ class FoldEnsemble:
 
         cfg = self.cfg
         mesh = self.mesh
+        scen = self.scenario
+        has_rfi = scen is not None and "rfi" in scen.names()
+        self._has_rfi = has_rfi
 
-        def _local(keys, dms, norms, profiles, freqs, chan_ids):
-            # one shard: a sub-batch of observations x a slab of channels
-            return jax.vmap(
-                lambda k, d, n: fold_pipeline(
-                    k, d, n, profiles, cfg, freqs=freqs, chan_ids=chan_ids
-                )
-            )(keys, dms, norms)
+        if scen is None:
+            def _local(keys, dms, norms, profiles, freqs, chan_ids):
+                # one shard: a sub-batch of observations x a slab of
+                # channels
+                return jax.vmap(
+                    lambda k, d, n: fold_pipeline(
+                        k, d, n, profiles, cfg, freqs=freqs,
+                        chan_ids=chan_ids
+                    )
+                )(keys, dms, norms)
+        else:
+            def _local(keys, dms, norms, scp, profiles, freqs, chan_ids):
+                # scenario build: the (B, n_params) per-observation
+                # parameter matrix rides the obs sharding as one extra
+                # traced input; the stack itself is static
+                return jax.vmap(
+                    lambda k, d, n, sp: fold_pipeline(
+                        k, d, n, profiles, cfg, freqs=freqs,
+                        chan_ids=chan_ids, scenario=scen,
+                        scenario_params=sp)
+                )(keys, dms, norms, scp)
 
+        _in_specs = (
+            (P(OBS_AXIS),) * 3
+            + ((P(OBS_AXIS, None),) if scen is not None else ())
+            + (P(CHAN_AXIS, None), P(CHAN_AXIS), P(CHAN_AXIS))
+        )
         self._run_sharded = jax.jit(
             shard_map(
                 _local,
                 mesh=mesh,
-                in_specs=(
-                    P(OBS_AXIS),
-                    P(OBS_AXIS),
-                    P(OBS_AXIS),
-                    P(CHAN_AXIS, None),
-                    P(CHAN_AXIS),
-                    P(CHAN_AXIS),
-                ),
+                in_specs=_in_specs,
                 out_specs=P(OBS_AXIS, CHAN_AXIS, None),
             )
         )
 
-        def _local_quantized(keys, dms, norms, profiles, freqs, chan_ids):
+        def _rfi_masks(args):
+            # in-graph ground-truth RFI mask (B_loc, C_loc, nsub),
+            # recomputed from the SAME keys/params as the injection (a
+            # pure function of them) — the scenario analogue of the
+            # fused finite-mask guard, feeding the PR-2 mask pipeline
+            from ..scenarios.registry import rfi_truth_mask
+
+            keys, scp, chan_ids = args[0], args[3], args[-1]
+            return jax.vmap(
+                lambda k, sp: rfi_truth_mask(k, scen, sp, nsub=cfg.nsub,
+                                             chan_ids=chan_ids)
+            )(keys, scp)
+
+        def _local_quantized(*args):
             # same pipeline, then in-graph per-(subint, channel) int16
             # quantization — the export leaves the device as quarter-size
             # bytes plus real DAT_SCL/DAT_OFFS columns.  Per-row reductions
@@ -191,46 +251,17 @@ class FoldEnsemble:
             # per observation): per (obs, channel) True iff every sample is
             # finite, reduced in-graph BEFORE quantization — a NaN/Inf
             # would otherwise be silently swallowed into the int16 codes.
-            blocks = _local(keys, dms, norms, profiles, freqs, chan_ids)
+            # RFI-enabled scenario builds append the ground-truth
+            # contamination mask as a fifth output.
+            blocks = _local(*args)
             finite = jnp.all(jnp.isfinite(blocks), axis=-1)  # (B_loc, C_loc)
             data, scl, offs = jax.vmap(
                 lambda b: subint_quantize(b, cfg.nsub, cfg.nph)
             )(blocks)
-            return data, scl, offs, finite
-
-        _quant_specs = dict(
-            mesh=mesh,
-            in_specs=(
-                P(OBS_AXIS),
-                P(OBS_AXIS),
-                P(OBS_AXIS),
-                P(CHAN_AXIS, None),
-                P(CHAN_AXIS),
-                P(CHAN_AXIS),
-            ),
-            out_specs=(
-                P(OBS_AXIS, None, CHAN_AXIS, None),
-                P(OBS_AXIS, None, CHAN_AXIS),
-                P(OBS_AXIS, None, CHAN_AXIS),
-                P(OBS_AXIS, CHAN_AXIS),
-            ),
-        )
-        self._run_sharded_quantized = jax.jit(
-            shard_map(_local_quantized, **_quant_specs)
-        )
-
-        def _local_quantized_be(keys, dms, norms, profiles, freqs, chan_ids):
-            # big-endian variant: byte-swap the int16 payload in-graph so
-            # the host PSRFITS writer refills its '>i2' record arrays with
-            # a same-dtype memcpy instead of a byteswapping cast (the
-            # measured bound of the packed bulk-export write machinery)
-            d, s, o, m = _local_quantized(keys, dms, norms, profiles, freqs,
-                                          chan_ids)
-            return swap16(d), s, o, m
-
-        self._run_sharded_quantized_be = jax.jit(
-            shard_map(_local_quantized_be, **_quant_specs)
-        )
+            out = (data, scl, offs, finite)
+            if has_rfi:
+                out = out + (_rfi_masks(args),)
+            return out
 
         def _pack_triple(d, s, o):
             # fuse (data, scl, offs) into ONE int16 buffer per chunk so
@@ -241,31 +272,34 @@ class FoldEnsemble:
             # many.  scl/offs ride along bitcast to int16 pairs appended
             # on the bin axis — (B, nsub, C, nbin+4) — and the host
             # recovers them exactly by reinterpreting the tail bytes
-            # (ensemble._split_packed_chunk); bitcast is bit-exact, so the
-            # unpacked triple is identical to the unfused programs'.
+            # (ensemble._split_packed_chunk); bitcast is bit-exact.
+            # This packed family is the ONLY quantized program shape:
+            # run_quantized/run_quantized_at split the same buffer with
+            # exact slice/bitcast ops.  A second unfused program variant
+            # used to exist, and on scenario builds XLA laid out the fold
+            # core's FFT differently between the two, flipping codes at
+            # rounding boundaries (±1 LSB) between run_quantized and
+            # iter_chunks — one program family makes the bit-identity
+            # contract hold by construction.
             s2 = jax.lax.bitcast_convert_type(s, jnp.int16)
             o2 = jax.lax.bitcast_convert_type(o, jnp.int16)
             return jnp.concatenate([d, s2, o2], axis=-1)
 
-        def _local_quantized_packed(keys, dms, norms, profiles, freqs,
-                                    chan_ids):
-            d, s, o, m = _local_quantized(keys, dms, norms, profiles, freqs,
-                                          chan_ids)
-            return _pack_triple(d, s, o), m
+        def _local_quantized_packed(*args):
+            out = _local_quantized(*args)
+            return (_pack_triple(out[0], out[1], out[2]),) + out[3:]
 
-        def _local_quantized_packed_be(keys, dms, norms, profiles, freqs,
-                                       chan_ids):
-            d, s, o, m = _local_quantized(keys, dms, norms, profiles, freqs,
-                                          chan_ids)
-            return _pack_triple(swap16(d), s, o), m
+        def _local_quantized_packed_be(*args):
+            out = _local_quantized(*args)
+            return (_pack_triple(swap16(out[0]), out[1], out[2]),) + out[3:]
 
         _packed_specs = dict(
             mesh=mesh,
-            in_specs=_quant_specs["in_specs"],
+            in_specs=_in_specs,
             out_specs=(
                 P(OBS_AXIS, None, CHAN_AXIS, None),
                 P(OBS_AXIS, CHAN_AXIS),
-            ),
+            ) + ((P(OBS_AXIS, CHAN_AXIS, None),) if has_rfi else ()),
         )
         self._run_sharded_quantized_packed = jax.jit(
             shard_map(_local_quantized_packed, **_packed_specs)
@@ -281,33 +315,97 @@ class FoldEnsemble:
         if noise_norms is not None and np.shape(noise_norms) != (n_obs,):
             raise ValueError(f"noise_norms must have shape ({n_obs},)")
 
-    def _prep_inputs(self, n_obs, seed, dms, noise_norms):
-        """Per-observation keys/DMs/norms, padded to the obs-shard count and
-        placed with the obs sharding.  Returns ``(keys, dms, norms, pad)``."""
+    def _validate_scenario_params(self, n_obs, scenario_params):
+        """Every key must belong to the staged stack; per-observation
+        arrays must be ``(n_obs,)`` (scalars broadcast)."""
+        if self.scenario is None:
+            if scenario_params:
+                raise ValueError(
+                    "scenario_params given but this ensemble was built "
+                    "without a scenario stack; pass scenario=[...] to "
+                    "FoldEnsemble")
+            return
+        names = self.scenario.param_names()
+        sp = dict(scenario_params or {})
+        unknown = sorted(set(sp) - set(names))
+        if unknown:
+            raise ValueError(
+                f"unknown scenario parameter(s) {unknown}; stack "
+                f"{self.scenario.labels()} takes {list(names)}")
+        for k, v in sp.items():
+            if np.ndim(v) not in (0, 1):
+                raise ValueError(f"scenario parameter {k} must be a "
+                                 "scalar or a (n_obs,) array")
+            if np.ndim(v) == 1 and np.shape(v) != (n_obs,):
+                raise ValueError(
+                    f"scenario parameter {k} must have shape ({n_obs},), "
+                    f"got {np.shape(v)}")
+
+    def _prep_scenario(self, idx, scenario_params):
+        """The ``(len(idx), n_params)`` traced scenario-parameter matrix
+        for the observation indices ``idx``, obs-sharded; registry
+        defaults fill unset knobs.  ``None`` for scenario-free builds."""
+        if self.scenario is None:
+            return None
+        from ..scenarios.registry import _param
+
+        sp = dict(scenario_params or {})
+        cols = []
+        for name in self.scenario.param_names():
+            v = sp.get(name, _param(name).default)
+            if np.ndim(v) == 0:
+                cols.append(np.full(len(idx), float(v), np.float32))
+            else:
+                cols.append(np.asarray(v, np.float32)[idx])
+        mat = np.stack(cols, axis=1) if cols else np.zeros(
+            (len(idx), 0), np.float32)
+        return jax.device_put(mat,
+                              NamedSharding(self.mesh, P(OBS_AXIS, None)))
+
+    def _program_args(self, keys, dms, norms, scp):
+        """Assemble one program's positional inputs (scenario matrix
+        inserted only on scenario builds, matching the in_specs)."""
+        base = (keys, dms, norms)
+        if self.scenario is not None:
+            base = base + (scp,)
+        return base + (self._profiles, self._freqs, self._chan_ids)
+
+    def _prep_inputs(self, n_obs, seed, dms, noise_norms,
+                     scenario_params=None):
+        """Per-observation keys/DMs/norms (+ scenario parameter matrix),
+        padded to the obs-shard count and placed with the obs sharding.
+        Returns ``(keys, dms, norms, scp, pad)``."""
         self._validate_per_obs(n_obs, dms, noise_norms)
+        self._validate_scenario_params(n_obs, scenario_params)
         n_obs_shards = self.mesh.shape[OBS_AXIS]
         pad = (-n_obs) % n_obs_shards
         # tile modulo n_obs so any pad size works (even pad > n_obs)
         idx = np.arange(n_obs + pad) % n_obs
         keys, dms, norms = self._prep_chunk(idx, seed, dms, noise_norms)
-        return keys, dms, norms, pad
+        return keys, dms, norms, self._prep_scenario(idx, scenario_params), pad
 
-    def run(self, n_obs, seed=0, dms=None, noise_norms=None):
+    def run(self, n_obs, seed=0, dms=None, noise_norms=None,
+            scenario_params=None):
         """Simulate ``n_obs`` observations; returns ``(n_obs, Nchan, Nsamp)``
         sharded over the mesh.
 
         The batch is padded up to a multiple of the obs-axis size and trimmed
         after, so any ``n_obs`` works.  Per-observation keys derive from
         ``seed`` by fold-in: results are identical for any mesh shape.
+
+        ``scenario_params`` (scenario builds only): dict of
+        ``{knob: scalar or (n_obs,) array}`` for the staged stack's
+        parameters (:meth:`ScenarioStack.param_names`); unset knobs take
+        registry defaults.
         """
-        keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
-        out = self._run_sharded(
-            keys, dms, norms, self._profiles, self._freqs, self._chan_ids
-        )
+        keys, dms, norms, scp, pad = self._prep_inputs(
+            n_obs, seed, dms, noise_norms, scenario_params)
+        out = self._run_sharded(*self._program_args(keys, dms, norms, scp))
         return out[:n_obs] if pad else out
 
     def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None,
-                      return_finite=False):
+                      return_finite=False, return_rfi=False,
+                      scenario_params=None):
         """Simulate ``n_obs`` observations and quantize ON DEVICE to PSRFITS
         int16 subints (:func:`~psrsigsim_tpu.ops.subint_quantize`).
 
@@ -336,16 +434,42 @@ class FoldEnsemble:
         is fused into the same program (checkify-style accumulation — no
         per-observation host round-trip); the run supervisor keys its NaN
         quarantine off it.
+
+        ``return_rfi=True`` (RFI-enabled scenario builds only) appends
+        the in-graph ground-truth contamination mask — a ``(n_obs,
+        Nchan, nsub)`` bool array, True where the injected RFI landed —
+        computed in the SAME fused program from the same keys/params as
+        the injection.  ``scenario_params`` as :meth:`run`.
         """
-        keys, dms, norms, pad = self._prep_inputs(n_obs, seed, dms, noise_norms)
-        data, scl, offs, finite = self._run_sharded_quantized(
-            keys, dms, norms, self._profiles, self._freqs, self._chan_ids
-        )
+        if return_rfi and not self._has_rfi:
+            raise ValueError(
+                "return_rfi requires an ensemble built with an RFI "
+                "scenario (FoldEnsemble(scenario=['rfi', ...]))")
+        keys, dms, norms, scp, pad = self._prep_inputs(
+            n_obs, seed, dms, noise_norms, scenario_params)
+        out = self._run_sharded_quantized_packed(
+            *self._program_args(keys, dms, norms, scp))
         if pad:
-            data, scl, offs, finite = (data[:n_obs], scl[:n_obs],
-                                       offs[:n_obs], finite[:n_obs])
+            out = tuple(a[:n_obs] for a in out)
+        data, scl, offs = self._split_packed_device(out[0])
+        result = (data, scl, offs)
         if return_finite:
-            return data, scl, offs, finite
+            result = result + (out[1],)
+        if return_rfi:
+            result = result + (out[-1],)
+        return result
+
+    def _split_packed_device(self, packed):
+        """Exact (slice + bitcast) device-side inverse of ``_pack_triple``
+        — the value-level twin of the host :func:`_split_packed_chunk`,
+        so every quantized entry point consumes the SAME compiled program
+        family and the triple is bit-identical everywhere."""
+        nbin = self.cfg.nph
+        data = packed[..., :nbin]
+        scl = jax.lax.bitcast_convert_type(
+            packed[..., nbin:nbin + 2], jnp.float32)
+        offs = jax.lax.bitcast_convert_type(
+            packed[..., nbin + 2:nbin + 4], jnp.float32)
         return data, scl, offs
 
     def _prep_chunk(self, idx, seed, dms_full, norms_full, fold_salt=None):
@@ -383,22 +507,49 @@ class FoldEnsemble:
                 jax.device_put(norms, obs_sharding))
 
     def run_quantized_at(self, indices, seed=0, dms=None, noise_norms=None,
-                         byte_order="little", fold_salt=None):
+                         byte_order="little", fold_salt=None,
+                         scenario_params=None, return_rfi=False):
         """Quantize exactly the observations ``indices`` (global ids) in
         one dispatch — the run supervisor's quarantine/retry primitive.
 
-        ``dms`` / ``noise_norms`` are the FULL per-observation arrays of
-        the parent run (or None), indexed by the global ids, so a re-run
-        observation sees exactly the inputs the main pass gave it.
+        ``dms`` / ``noise_norms`` (and, on scenario builds, any
+        per-observation ``scenario_params`` arrays) are the FULL
+        per-observation arrays of the parent run (or None), indexed by
+        the global ids, so a re-run observation sees exactly the inputs
+        the main pass gave it.
         ``fold_salt`` (see :meth:`_prep_chunk`): None reproduces the main
         pass bit-for-bit; an int folds a fresh stream for every listed
         observation.  ``byte_order`` as :meth:`iter_chunks`.
 
         Returns ``(data, scl, offs, finite)`` trimmed to ``len(indices)``,
-        in the order given.
+        in the order given; ``return_rfi=True`` (RFI-enabled scenario
+        builds only) appends the ground-truth contamination mask of THIS
+        run's realization — under ``fold_salt`` that is the fresh fold's
+        truth, which is what the supervisor's healed-observation record
+        must follow.
         """
         if byte_order not in ("little", "big"):
             raise ValueError("byte_order must be 'little' or 'big'")
+        if return_rfi and not self._has_rfi:
+            raise ValueError(
+                "return_rfi requires an ensemble built with an RFI "
+                "scenario (FoldEnsemble(scenario=['rfi', ...]))")
+        # same loud-rejection contract as run/run_quantized/iter_chunks —
+        # names only: per-obs arrays here are the PARENT run's full
+        # arrays (indexed by global ids, like dms/noise_norms), so their
+        # length is not ours to check
+        if scenario_params:
+            if self.scenario is None:
+                raise ValueError(
+                    "scenario_params passed without a scenario stack "
+                    "(build the ensemble with FoldEnsemble(scenario=[...]))")
+            known = set(self.scenario.param_names())
+            bad = sorted(set(scenario_params) - known)
+            if bad:
+                raise ValueError(
+                    f"unknown scenario parameter(s) {bad}; the staged "
+                    f"stack {self.scenario.labels()} accepts "
+                    f"{sorted(known)}")
         indices = np.asarray(indices, np.int64).reshape(-1)
         if indices.size == 0:
             raise ValueError("indices must be non-empty")
@@ -408,18 +559,22 @@ class FoldEnsemble:
         idx = indices[np.arange(n + pad) % n]  # tile modulo, as _prep_inputs
         keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms, noise_norms,
                                                 fold_salt=fold_salt)
-        prog = (self._run_sharded_quantized_be if byte_order == "big"
-                else self._run_sharded_quantized)
-        data, scl, offs, finite = prog(
-            keys, dms_c, norms_c, self._profiles, self._freqs,
-            self._chan_ids,
-        )
-        return data[:n], scl[:n], offs[:n], finite[:n]
+        scp = self._prep_scenario(idx, scenario_params)
+        prog = (self._run_sharded_quantized_packed_be if byte_order == "big"
+                else self._run_sharded_quantized_packed)
+        out = prog(*self._program_args(keys, dms_c, norms_c, scp))
+        data, scl, offs = self._split_packed_device(out[0])
+        finite = out[1]
+        result = (data[:n], scl[:n], offs[:n], finite[:n])
+        if return_rfi:
+            result = result + (out[-1][:n],)
+        return result
 
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
                     noise_norms=None, quantized=False, progress=None,
                     skip_chunk=None, prefetch=1, byte_order="little",
-                    finite_mask=False, fetch_ahead=0, timers=None):
+                    finite_mask=False, fetch_ahead=0, timers=None,
+                    rfi_mask=False, scenario_params=None):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -466,6 +621,13 @@ class FoldEnsemble:
         non-finite observations off this mask instead of re-scanning the
         payload on host.
 
+        ``rfi_mask`` (quantized, RFI-enabled scenario builds only):
+        append the in-graph ``(count, Nchan, nsub)`` ground-truth RFI
+        contamination mask to each yielded tuple (after the finite mask
+        when both are requested) — the labeled-dataset exit path, and
+        what the supervised exporter journals as scenario provenance.
+        ``scenario_params`` as :meth:`run`.
+
         ``fetch_ahead``: with ``fetch_ahead >= 1``, device->host transfers
         move to a dedicated fetch thread feeding a bounded queue of (at
         most) ``fetch_ahead`` fetched chunks — the link stays busy while
@@ -495,7 +657,14 @@ class FoldEnsemble:
             raise ValueError("byte_order must be 'little' or 'big'")
         if finite_mask and not quantized:
             raise ValueError("finite_mask requires quantized=True")
+        if rfi_mask and not quantized:
+            raise ValueError("rfi_mask requires quantized=True")
+        if rfi_mask and not self._has_rfi:
+            raise ValueError(
+                "rfi_mask requires an ensemble built with an RFI "
+                "scenario (FoldEnsemble(scenario=['rfi', ...]))")
         self._validate_per_obs(n_obs, dms, noise_norms)
+        self._validate_scenario_params(n_obs, scenario_params)
         if chunk_size <= 0:
             raise ValueError("chunk_size must be positive")
         if prefetch < 0:
@@ -516,21 +685,20 @@ class FoldEnsemble:
             idx = (start + np.arange(chunk_size)) % n_obs
             keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
                                                     noise_norms)
+            scp = self._prep_scenario(idx, scenario_params)
             if quantized:
                 prog = (self._run_sharded_quantized_packed_be
                         if byte_order == "big"
                         else self._run_sharded_quantized_packed)
-                packed, m = prog(
-                    keys, dms_c, norms_c, self._profiles, self._freqs,
-                    self._chan_ids,
-                )
-                dev = ((packed[:count], m[:count]) if finite_mask
-                       else (packed[:count],))
+                outs = prog(*self._program_args(keys, dms_c, norms_c, scp))
+                dev = (outs[0][:count],)
+                if finite_mask:
+                    dev = dev + (outs[1][:count],)
+                if rfi_mask:
+                    dev = dev + (outs[-1][:count],)
             else:
                 out = self._run_sharded(
-                    keys, dms_c, norms_c, self._profiles, self._freqs,
-                    self._chan_ids,
-                )
+                    *self._program_args(keys, dms_c, norms_c, scp))
                 dev = out[:count]
             if timers is not None:
                 timers.add("dispatch", _time.perf_counter() - t0)
@@ -539,12 +707,12 @@ class FoldEnsemble:
         def _fetch(dev_block):
             # one batched device->host copy per chunk (device_get on the
             # whole pytree, and for quantized chunks ONE fused buffer plus
-            # the tiny finite mask), not one transfer per array
+            # the tiny finite/RFI masks), not one transfer per array
             t0 = _time.perf_counter()
             host = jax.device_get(dev_block)
             if quantized:
                 d, s, o = _split_packed_chunk(host[0], nbin)
-                block = (d, s, o, host[1]) if finite_mask else (d, s, o)
+                block = (d, s, o) + tuple(host[1:])
             else:
                 block = host
             if timers is not None:

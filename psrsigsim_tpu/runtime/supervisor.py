@@ -121,6 +121,7 @@ class RunSupervisor:
         self._hashes = {}        # basename -> sha256 of committed files
         self._verified = set()   # paths already proven ok THIS run
         self._quarantined = set()  # ever flagged non-finite this run
+        self._rfi_obs = {}       # global obs id -> contaminated cell count
         self._retried = set()
         self._recovered = set()
         self._still_bad = set()
@@ -164,6 +165,16 @@ class RunSupervisor:
                     valid_end += len(line)
                     if rec.get("e") == "commit":
                         self._hashes.update(rec.get("files", {}))
+                    elif rec.get("e") in ("rfi", "rfi_retry"):
+                        # replay the scenario-truth record so a resumed
+                        # export's manifest summary stays COMPLETE (the
+                        # skipped committed chunks never re-observe)
+                        for i, c in zip(rec.get("obs", ()),
+                                        rec.get("cells", ())):
+                            if c:
+                                self._rfi_obs[int(i)] = int(c)
+                            else:
+                                self._rfi_obs.pop(int(i), None)
         except FileNotFoundError:
             return
         if valid_end < os.path.getsize(self.journal_path):
@@ -233,6 +244,62 @@ class RunSupervisor:
         if out:
             self._sync_journal()
         return out
+
+    def observe_rfi(self, start, mask):
+        """Digest one chunk's in-graph ground-truth RFI mask ``(count,
+        Nchan, nsub)`` from the scenario engine: journal which
+        observations carry injected RFI and how many (channel, subint)
+        cells it touches — provenance, not quarantine (the contamination
+        is intentional physics; nothing re-runs).  Rides the same
+        fsync'd append-only journal as the finite guard, so a resumed
+        export keeps a complete contamination record."""
+        mask = np.asarray(mask)
+        hit = np.where(mask.any(axis=tuple(range(1, mask.ndim))))[0]
+        fresh = []
+        for j in hit:
+            i = start + int(j)
+            cells = int(mask[j].sum())
+            if self._rfi_obs.get(i) == cells:
+                continue  # a resumed chunk re-observing the same truth
+            self._rfi_obs[i] = cells
+            fresh.append((i, cells))
+        if fresh:
+            self._append_journal({
+                "e": "rfi", "start": int(start),
+                "obs": [i for i, _ in fresh],
+                "cells": [c for _, c in fresh]})
+            self._sync_journal()
+
+    def observe_rfi_retry(self, indices, mask):
+        """Overwrite the RFI truth for re-folded observations: a healed
+        (``fold_salt``) re-run draws a FRESH realization, so the main
+        pass's record for these observations is stale — the journal and
+        manifest must follow the bytes actually delivered.  ``mask`` rows
+        align with ``indices``; zero contaminated cells DELETES the
+        entry (the healed draw may carry no RFI at all).  Also used to
+        drop the record of still-bad observations whose files are not
+        written."""
+        mask = np.asarray(mask) if mask is not None else None
+        changed = []
+        for j, i in enumerate(indices):
+            i = int(i)
+            cells = int(mask[j].sum()) if mask is not None else 0
+            prev = self._rfi_obs.get(i)
+            if cells == 0:
+                if prev is None:
+                    continue
+                del self._rfi_obs[i]
+            else:
+                if prev == cells:
+                    continue
+                self._rfi_obs[i] = cells
+            changed.append((i, cells))
+        if changed:
+            self._append_journal({
+                "e": "rfi_retry",
+                "obs": [i for i, _ in changed],
+                "cells": [c for _, c in changed]})
+            self._sync_journal()
 
     def chunk_committed(self, token, results):
         """A chunk's files are durably on disk: record their hashes in
@@ -334,6 +401,13 @@ class RunSupervisor:
         man = _load_manifest(self.out_dir) or {}
         man["files"] = dict(sorted(self._hashes.items()))
         man["quarantined"] = sorted(int(i) for i in self._still_bad)
+        if self._rfi_obs:
+            # scenario provenance: how much injected RFI the dataset
+            # carries (per-observation detail lives in the journal)
+            man["rfi"] = {
+                "obs_with_rfi": len(self._rfi_obs),
+                "contaminated_cells": int(sum(self._rfi_obs.values())),
+            }
         _write_manifest(self.out_dir, man)
         self.close()
         return RunResult(paths, self._still_bad, self._retried,

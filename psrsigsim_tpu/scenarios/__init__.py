@@ -1,0 +1,51 @@
+"""Scenario engine: in-graph physics effects as a registry of priors +
+request types.
+
+Each registered effect — scintillation gain screens, impulsive/narrowband
+RFI with a ground-truth mask, single-pulse/transient energy
+distributions — is declared ONCE in :mod:`.registry` and becomes
+reachable from all three entry points:
+
+* **ensemble API** — ``FoldEnsemble(..., scenario=[...])`` with
+  per-observation parameters on ``run``/``run_quantized``/``iter_chunks``;
+* **Monte-Carlo studies** — any registered parameter is a prior knob
+  (``MonteCarloStudy`` infers the static stack from the declared priors);
+* **serving layer** — the ``"scenarios"`` geometry field + per-request
+  parameter fields on ``/simulate`` specs.
+
+Disabled effects cost nothing (the pre-scenario program compiles
+bit-identically); enabled effects are bit-identical across chunk sizes,
+mesh shapes, and serving bucket widths because every draw keys off the
+observation key via the effect's own RNG stage.  See
+docs/tutorial_11_scenarios.md.
+"""
+
+from .registry import (
+    EFFECT_ORDER,
+    EFFECTS,
+    Effect,
+    EffectParam,
+    ScenarioStack,
+    apply_additive_effects,
+    apply_pulse_effects,
+    default_params,
+    parse_stack,
+    rfi_truth_mask,
+    scenario_knobs,
+    stack_from_knobs,
+)
+
+__all__ = [
+    "EFFECTS",
+    "EFFECT_ORDER",
+    "Effect",
+    "EffectParam",
+    "ScenarioStack",
+    "parse_stack",
+    "scenario_knobs",
+    "stack_from_knobs",
+    "default_params",
+    "apply_pulse_effects",
+    "apply_additive_effects",
+    "rfi_truth_mask",
+]

@@ -1,0 +1,385 @@
+"""The scenario registry: each physical effect declared ONCE.
+
+An effect entry names, in one place, everything the three entry points
+need — its in-graph op (:mod:`psrsigsim_tpu.ops.scenario`), its RNG
+stage (:data:`psrsigsim_tpu.utils.rng.STAGES`), its parameter schema
+(name/default/bounds, which becomes both an ``mc`` prior knob and a
+serve request field), and its static modes.  Adding a new effect is one
+``_register`` call plus an op: the ensemble API, the Monte-Carlo study
+engine, and the serving layer pick it up without per-subsystem plumbing
+(ROADMAP item 4's "a new scenario = a new prior + a new request field").
+
+A :class:`ScenarioStack` is the STATIC (trace-time) selection of enabled
+effects (+ mode where an effect has modes); the traced per-observation
+parameter vector follows :meth:`ScenarioStack.param_names` order.  The
+invariants every effect must honor:
+
+* **disabled is free** — ``stack=None`` compiles the exact pre-scenario
+  program: the apply hooks below are never entered, so the jaxpr is
+  bit-identical to a build without the scenario engine (pinned by
+  tests/test_scenarios.py's jaxpr-equality gate);
+* **keyed draws only** — every random quantity keys off the
+  observation/trial/request key via the effect's own stage, folded by
+  GLOBAL integers (channel ids, subint ids, scintle cells), so enabled
+  results are bit-identical across chunk sizes, mesh shapes, and serve
+  bucket widths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["EffectParam", "Effect", "EFFECTS", "EFFECT_ORDER",
+           "SP_MODE_KNOBS", "ScenarioStack", "parse_stack", "stack_label",
+           "scenario_knobs", "stack_from_knobs", "param_dict",
+           "default_params", "apply_pulse_effects",
+           "apply_additive_effects", "rfi_truth_mask"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EffectParam:
+    """One traced parameter of an effect: the single schema that feeds
+    the MC prior knob table, the serve request-field table, and the
+    in-graph default when a caller leaves the knob unset."""
+
+    name: str        # fully-qualified, effect-prefixed ("scint_dnu_d_mhz")
+    default: float
+    lo: float
+    hi: float
+    doc: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    """One registered physical effect (declarative; the in-graph
+    application lives in the apply hooks below, dispatched by name)."""
+
+    name: str
+    stage: str               # RNG stage (utils/rng.py STAGES)
+    params: tuple            # EffectParam, canonical order
+    modes: tuple = ()        # static modes; () = modeless
+    default_mode: str = ""
+    doc: str = ""
+
+    def param_names(self):
+        return tuple(p.name for p in self.params)
+
+
+def _register(effect, table):
+    if effect.name in table:
+        raise ValueError(f"duplicate effect {effect.name!r}")
+    taken = {p.name for e in table.values() for p in e.params}
+    clash = taken & {p.name for p in effect.params}
+    if clash:
+        raise ValueError(
+            f"effect {effect.name!r} re-declares parameter(s) "
+            f"{sorted(clash)} owned by another effect")
+    table[effect.name] = effect
+    return effect
+
+
+EFFECTS = {}
+
+_register(Effect(
+    name="scintillation",
+    stage="scint",
+    params=(
+        EffectParam("scint_dnu_d_mhz", 50.0, 1e-4, 1e5,
+                    "scintillation bandwidth at band center (MHz); "
+                    "scaled per channel by the thin-screen nu^4.4 law"),
+        EffectParam("scint_dt_d_s", 60.0, 1e-3, 1e7,
+                    "scintillation timescale at band center (s); "
+                    "scaled per channel by nu^1.2"),
+        EffectParam("scint_mod", 1.0, 0.0, 1.0,
+                    "modulation index: 0 = no modulation, 1 = saturated "
+                    "strong scintillation (unit-mean exponential gains)"),
+    ),
+    doc="per-(channel, subint) dynamic-spectrum gain screen drawn from "
+        "scintle-cell-folded keys (ops.scint_gain)",
+), EFFECTS)
+
+_register(Effect(
+    name="rfi",
+    stage="rfi",
+    params=(
+        EffectParam("rfi_imp_prob", 0.1, 0.0, 1.0,
+                    "per-subint probability of a broadband impulsive "
+                    "burst"),
+        EffectParam("rfi_imp_snr", 5.0, 0.0, 1e4,
+                    "impulsive burst level in units of the mean "
+                    "radiometer noise level"),
+        EffectParam("rfi_nb_prob", 0.1, 0.0, 1.0,
+                    "per-channel probability of a persistent narrowband "
+                    "tone"),
+        EffectParam("rfi_nb_snr", 3.0, 0.0, 1e4,
+                    "narrowband tone level in units of the mean "
+                    "radiometer noise level"),
+    ),
+    doc="impulsive + narrowband RFI injection with an in-graph ground-"
+        "truth contamination mask (ops.rfi_levels)",
+), EFFECTS)
+
+_register(Effect(
+    name="single_pulse",
+    stage="transient",
+    params=(
+        EffectParam("sp_sigma", 0.5, 0.0, 5.0,
+                    "log-normal mode: log-energy width sigma "
+                    "(unit-mean pulse-energy distribution)"),
+        EffectParam("sp_alpha", 2.5, 1.05, 10.0,
+                    "power-law mode: Pareto index alpha (unit-mean "
+                    "giant-pulse tail)"),
+        EffectParam("sp_amp", 10.0, 0.0, 1e4,
+                    "frb mode: amplitude of the one-off burst in "
+                    "envelope units"),
+    ),
+    modes=("lognormal", "powerlaw", "frb"),
+    default_mode="lognormal",
+    doc="per-pulse energy distribution modulating the fold envelope "
+        "(ops.pulse_energies); frb mode emits exactly one burst",
+), EFFECTS)
+
+#: canonical effect order — stacks, param vectors and serve field lists
+#: all follow it, so a stack's traced-parameter layout is deterministic
+EFFECT_ORDER = tuple(EFFECTS)
+
+#: which param selects which single_pulse mode (MC prior inference)
+SP_MODE_KNOBS = {"sp_sigma": "lognormal", "sp_alpha": "powerlaw",
+                 "sp_amp": "frb"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioStack:
+    """The static enabled-effect selection: ``((name, mode), ...)`` in
+    :data:`EFFECT_ORDER` order.  Frozen and hashable, so it rides as a
+    jit static argument; equal stacks compile one program."""
+
+    entries: tuple
+
+    def __bool__(self):
+        return bool(self.entries)
+
+    def names(self):
+        return tuple(n for n, _ in self.entries)
+
+    def mode(self, name):
+        for n, m in self.entries:
+            if n == name:
+                return m
+        return None
+
+    def labels(self):
+        """Canonical string form, one per effect: ``name`` (modeless or
+        default mode) / ``name:mode``."""
+        out = []
+        for n, m in self.entries:
+            eff = EFFECTS[n]
+            out.append(n if (not eff.modes or m == eff.default_mode)
+                       else f"{n}:{m}")
+        return out
+
+    def label(self):
+        """One stable human-readable id for counters/metrics."""
+        return stack_label(self.labels())
+
+    def param_names(self):
+        """Traced parameter layout: every enabled effect's params in
+        registry order (mode-independent, so a mode switch never moves
+        another parameter's slot)."""
+        return tuple(p for n, _ in self.entries
+                     for p in EFFECTS[n].param_names())
+
+    def describe(self):
+        """JSON-able canonical form (fingerprints, manifests, specs)."""
+        return list(self.labels())
+
+
+def stack_label(labels):
+    """THE canonical counter/metrics id for a list of effect labels —
+    the one format shared by :meth:`ScenarioStack.label` and the serve
+    layer's per-scenario request counters, so the two can never drift."""
+    labels = list(labels)
+    return "+".join(labels) if labels else "base"
+
+
+def parse_stack(items):
+    """Build a :class:`ScenarioStack` from effect labels.
+
+    ``items``: iterable of ``"name"`` / ``"name:mode"`` strings (or
+    ``(name, mode)`` pairs).  Order-insensitive — entries are canonical-
+    ized to :data:`EFFECT_ORDER`.  Returns ``None`` for an empty
+    selection (the disabled-is-free form).  Raises ValueError naming
+    every bad entry at once.
+    """
+    if items is None:
+        return None
+    if isinstance(items, ScenarioStack):
+        return items if items.entries else None
+    errors = []
+    chosen = {}
+    for it in items:
+        if isinstance(it, (tuple, list)) and len(it) == 2:
+            name, mode = str(it[0]), str(it[1])
+        else:
+            name, _, mode = str(it).partition(":")
+        eff = EFFECTS.get(name)
+        if eff is None:
+            errors.append(f"unknown effect {name!r}; known: "
+                          f"{list(EFFECT_ORDER)}")
+            continue
+        if eff.modes:
+            mode = mode or eff.default_mode
+            if mode not in eff.modes:
+                errors.append(f"{name}: unknown mode {mode!r}; valid: "
+                              f"{list(eff.modes)}")
+                continue
+        elif mode:
+            errors.append(f"{name}: takes no mode, got {mode!r}")
+            continue
+        if name in chosen and chosen[name] != mode:
+            errors.append(f"{name}: requested twice with modes "
+                          f"{chosen[name]!r} and {mode!r}")
+            continue
+        chosen[name] = mode
+    if errors:
+        raise ValueError("invalid scenario selection: " + "; ".join(errors))
+    entries = tuple((n, chosen[n]) for n in EFFECT_ORDER if n in chosen)
+    return ScenarioStack(entries) if entries else None
+
+
+def scenario_knobs():
+    """Every registered parameter name in canonical order — the
+    Monte-Carlo study engine appends these to its KNOBS table, so a
+    newly registered effect becomes a prior automatically."""
+    return tuple(p for n in EFFECT_ORDER for p in EFFECTS[n].param_names())
+
+
+def stack_from_knobs(knob_names):
+    """Infer the static stack from the set of prior knobs a study
+    declares: any ``scint_*`` knob enables scintillation, any ``rfi_*``
+    knob enables RFI, and exactly one of the single-pulse mode-selector
+    knobs (:data:`SP_MODE_KNOBS`) enables single_pulse in that mode.
+    Returns ``None`` when no scenario knob is present."""
+    present = set(knob_names)
+    labels = []
+    if present & set(EFFECTS["scintillation"].param_names()):
+        labels.append("scintillation")
+    if present & set(EFFECTS["rfi"].param_names()):
+        labels.append("rfi")
+    sp = sorted(present & set(SP_MODE_KNOBS))
+    if len(sp) > 1:
+        raise ValueError(
+            f"single_pulse mode is ambiguous: priors declare {sp}, which "
+            f"select modes {[SP_MODE_KNOBS[k] for k in sp]}; declare "
+            "exactly one of sp_sigma (lognormal), sp_alpha (powerlaw), "
+            "sp_amp (frb)")
+    if sp:
+        labels.append(f"single_pulse:{SP_MODE_KNOBS[sp[0]]}")
+    return parse_stack(labels)
+
+
+def param_dict(stack, values):
+    """Zip a traced parameter vector (ordered by
+    :meth:`ScenarioStack.param_names`) back into a name-keyed dict,
+    filling registry defaults for any name the vector does not carry
+    (the MC path samples only the knobs with priors)."""
+    import jax.numpy as jnp
+
+    names = stack.param_names()
+    if isinstance(values, dict):
+        return {n: (values[n] if n in values
+                    else jnp.float32(_param(n).default)) for n in names}
+    if len(values) != len(names):
+        raise ValueError(
+            f"scenario param vector has {len(values)} entries; stack "
+            f"{stack.labels()} expects {len(names)}: {list(names)}")
+    return {n: values[i] for i, n in enumerate(names)}
+
+
+def _param(name):
+    for eff in EFFECTS.values():
+        for p in eff.params:
+            if p.name == name:
+                return p
+    raise KeyError(name)
+
+
+def default_params(stack):
+    """Host-side default parameter vector (floats) for a stack."""
+    return tuple(_param(n).default for n in stack.param_names())
+
+
+# -- in-graph application hooks ---------------------------------------------
+# Called from simulate.pipeline._fold_core and mc.study._trial_block with
+# IDENTICAL stage keys and op order, which is what makes an MC trial and a
+# pipeline observation of the same scenario bit-identical (pinned by
+# tests/test_scenarios.py).
+
+
+def apply_pulse_effects(key, block, stack, params, *, nsub, nph, freqs,
+                        fcent_mhz, sublen_s, f_lo_mhz):
+    """Multiplicative effects on the synthesized pulse block
+    ``(Nchan, nsub*nph)`` (BEFORE nulling and radiometer noise):
+    scintillation gains, then single-pulse energies.  ``f_lo_mhz`` is
+    the GLOBAL band floor (``freqs`` may be a channel-shard slab; the
+    scintle-cell origin must not depend on the split)."""
+    from ..ops.scenario import pulse_energies, scint_gain
+    from ..utils.rng import stage_key
+
+    p = param_dict(stack, params)
+    for name, mode in stack.entries:
+        if name == "scintillation":
+            g = scint_gain(stage_key(key, "scint"), freqs, nsub,
+                           p["scint_dnu_d_mhz"], p["scint_dt_d_s"],
+                           p["scint_mod"], fcent_mhz, sublen_s,
+                           f_lo_mhz=f_lo_mhz)
+            block = (block.reshape(-1, nsub, nph)
+                     * g[:, :, None]).reshape(-1, nsub * nph)
+        elif name == "single_pulse":
+            sel = {"lognormal": "sp_sigma", "powerlaw": "sp_alpha",
+                   "frb": "sp_amp"}[mode]
+            e = pulse_energies(stage_key(key, "transient"), nsub, mode,
+                               p[sel])
+            block = (block.reshape(-1, nsub, nph)
+                     * e[None, :, None]).reshape(-1, nsub * nph)
+    return block
+
+
+def apply_additive_effects(key, block, stack, params, *, nsub, nph,
+                           chan_ids, noise_level):
+    """Additive effects on the post-noise block (RFI rides ON TOP of the
+    radiometer noise, like a real receiver sees it).  ``noise_level`` is
+    the mean radiometer level (``noise_df * noise_norm``) the SNR-unit
+    amplitudes scale against."""
+    from ..ops.scenario import rfi_levels
+    from ..utils.rng import stage_key
+
+    if "rfi" not in stack.names():
+        return block
+    p = param_dict(stack, params)
+    levels, _ = rfi_levels(stage_key(key, "rfi"), chan_ids, nsub,
+                           p["rfi_imp_prob"], p["rfi_imp_snr"],
+                           p["rfi_nb_prob"], p["rfi_nb_snr"])
+    import jax.numpy as jnp
+
+    lvl = levels * jnp.asarray(noise_level, jnp.float32)
+    return (block.reshape(-1, nsub, nph)
+            + lvl[:, :, None]).reshape(-1, nsub * nph)
+
+
+def rfi_truth_mask(key, stack, params, *, nsub, chan_ids):
+    """The ground-truth RFI contamination mask ``(Nchan, nsub)`` bool for
+    one observation — recomputed from the SAME keys/params as the
+    injection (a pure function of them), so any consumer can obtain the
+    truth without re-simulating.  Returns ``None`` when the stack does
+    not include RFI."""
+    from ..ops.scenario import rfi_levels
+    from ..utils.rng import stage_key
+
+    if stack is None or "rfi" not in stack.names():
+        return None
+    p = param_dict(stack, params)
+    _, mask = rfi_levels(stage_key(key, "rfi"), chan_ids, nsub,
+                         p["rfi_imp_prob"], p["rfi_imp_snr"],
+                         p["rfi_nb_prob"], p["rfi_nb_snr"])
+    return mask

@@ -19,7 +19,10 @@ endpoint               behavior
 ``GET /healthz``       200 ``{"ok": true, "queue_depth", "draining"}``.
 ``GET /metrics``       200: the service metrics dict — stage seconds +
                        latency p50/p95/p99, queue depths, per-bucket
-                       program hit counts, cache stats.
+                       program hit counts, cache stats, per-scenario
+                       request counters (``scenario_requests``) and
+                       per-effect device-time stages (``effect:*`` in
+                       ``stages``) for mixed-scenario traffic profiles.
 =====================  =====================================================
 
 Graceful drain: SIGTERM (and SIGINT) flips the service into draining —

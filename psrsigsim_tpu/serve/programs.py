@@ -77,6 +77,7 @@ class ProgramRegistry:
         self.widths = tuple(widths)
         self._lock = threading.Lock()
         self._geoms = {}          # geom hash -> (cfg, profiles, noise_norm)
+        self._stacks = {}         # geom hash -> ScenarioStack or None
         self._programs = {}       # (geom hash, width) -> compiled executable
         self._compile_counts = {}  # (geom hash, width) -> int
         self._calls = {}          # (geom hash, width) -> executions
@@ -97,17 +98,27 @@ class ProgramRegistry:
         with self._lock:
             return geom_hash in self._geoms
 
-    def register(self, geom_hash, cfg, profiles, noise_norm, warmup=True):
+    def register(self, geom_hash, cfg, profiles, noise_norm, warmup=True,
+                 scenario=None):
         """Stage one geometry bucket; with ``warmup`` (the default) every
         admitted width is AOT-compiled NOW, so the first request of this
-        geometry pays zero compile on the serving path."""
+        geometry pays zero compile on the serving path.  ``scenario``
+        (a :class:`~psrsigsim_tpu.scenarios.ScenarioStack` or None) is
+        part of the geometry by construction — the hash covers the spec's
+        ``scenarios`` field — and shapes the compiled program's inputs."""
         with self._lock:
             if geom_hash not in self._geoms:
                 self._geoms[geom_hash] = (cfg, np.asarray(profiles),
                                           float(noise_norm))
+                self._stacks[geom_hash] = scenario
         if warmup:
             for w in self.widths:
                 self.program(geom_hash, w)
+
+    def scenario_of(self, geom_hash):
+        """The registered geometry's scenario stack (None = base)."""
+        with self._lock:
+            return self._stacks[geom_hash]
 
     # -- programs ----------------------------------------------------------
 
@@ -119,12 +130,15 @@ class ProgramRegistry:
                 return w
         return self.widths[-1]
 
-    def _example_inputs(self, width):
+    def _example_inputs(self, width, scenario=None):
         import jax
 
         keys = jax.vmap(jax.random.key)(np.arange(width, dtype=np.uint32))
         z = np.zeros(width, np.float32)
-        return keys, z, z, z
+        if scenario is None:
+            return keys, z, z, z
+        sc = np.zeros((width, len(scenario.param_names())), np.float32)
+        return keys, z, z, z, sc
 
     def program(self, geom_hash, width):
         """The compiled executable for (geometry, width); AOT-compiles on
@@ -136,12 +150,14 @@ class ProgramRegistry:
             if prog is not None:
                 return prog
             cfg, profiles, _ = self._geoms[geom_hash]
+            stack = self._stacks[geom_hash]
         import jax
 
         from ..parallel.ensemble import build_width_bucket_fn
 
-        fn = build_width_bucket_fn(cfg, profiles)
-        lowered = jax.jit(fn).lower(*self._example_inputs(int(width)))
+        fn = build_width_bucket_fn(cfg, profiles, scenario=stack)
+        lowered = jax.jit(fn).lower(
+            *self._example_inputs(int(width), stack))
         compiled = lowered.compile()
         with self._lock:
             # a concurrent compile of the same key keeps the first one
@@ -150,13 +166,18 @@ class ProgramRegistry:
             prog = self._programs.setdefault(key, compiled)
         return prog
 
-    def execute(self, geom_hash, width, keys, dms, norms, null_fracs):
-        """Run one padded batch through the compiled program.  This is
-        the ONLY device entry of the serving layer; ``device_calls``
-        counts its invocations (the result-cache tests assert it stays
-        flat across repeated identical requests)."""
+    def execute(self, geom_hash, width, keys, dms, norms, null_fracs,
+                sc=None):
+        """Run one padded batch through the compiled program (``sc``:
+        the ``(width, n_params)`` scenario parameter matrix, scenario
+        geometries only).  This is the ONLY device entry of the serving
+        layer; ``device_calls`` counts its invocations (the result-cache
+        tests assert it stays flat across repeated identical requests)."""
         prog = self.program(geom_hash, width)
-        out = prog(keys, dms, norms, null_fracs)
+        args = (keys, dms, norms, null_fracs)
+        if sc is not None:
+            args = args + (sc,)
+        out = prog(*args)
         key = (geom_hash, int(width))
         with self._lock:
             self.device_calls += 1

@@ -39,20 +39,29 @@ import numpy as np
 
 from ..runtime.faults import should_fire
 from ..runtime.telemetry import StageTimers
+from ..scenarios.registry import EFFECT_ORDER, stack_label
 from .cache import ResultCache
 from .programs import DEFAULT_WIDTHS, ProgramRegistry
-from .spec import build_geometry, canonicalize, geometry_hash, spec_hash
+from .spec import (build_geometry, canonicalize, geometry_hash,
+                   scenario_param_vector, scenario_stack, spec_hash)
 
 __all__ = ["SimulationService", "RequestRejected", "RequestFailed",
-           "SERVE_STAGES", "SERVE_LATENCY_STAGES"]
+           "SERVE_STAGES", "SERVE_LATENCY_STAGES", "EFFECT_STAGES"]
+
+#: per-effect device-time stages: each batch's compute seconds are
+#: attributed to every effect its geometry enables, so ``/metrics``
+#: shows where device time goes under a mixed-scenario traffic profile
+EFFECT_STAGES = tuple(f"effect:{n}" for n in EFFECT_ORDER)
 
 #: stages the serving engine reports into StageTimers: per-call busy
 #: seconds for the engine's four phases plus the e2e request latency
-SERVE_STAGES = ("enqueue", "batch", "compute", "respond", "request")
+SERVE_STAGES = ("enqueue", "batch", "compute", "respond",
+                "request") + EFFECT_STAGES
 
-#: stages of SERVE_STAGES that are end-to-end latencies, not exclusive
-#: busy time — excluded from the snapshot's ``bottleneck`` pick
-SERVE_LATENCY_STAGES = ("request",)
+#: stages of SERVE_STAGES that are NOT exclusive busy time — e2e request
+#: latency, and the per-effect attributions (each re-counts compute
+#: seconds) — excluded from the snapshot's ``bottleneck`` pick
+SERVE_LATENCY_STAGES = ("request",) + EFFECT_STAGES
 
 
 class RequestRejected(Exception):
@@ -148,6 +157,10 @@ class SimulationService:
         self.expired = 0
         self.cache_hits = 0
         self.served = 0
+        # per-scenario-stack request counters (admitted submits,
+        # including cache hits), keyed by the stack label ("base",
+        # "scintillation+rfi", ...) — the /metrics traffic profile
+        self.scenario_requests = {}
         self._batcher = threading.Thread(target=self._batch_loop,
                                          daemon=True, name="pss-serve-batch")
         self._batcher.start()
@@ -163,7 +176,8 @@ class SimulationService:
         if not self.registry.known(gh):
             cfg, profiles, noise_norm = build_geometry(canonical)
             self.registry.register(gh, cfg, profiles, noise_norm,
-                                   warmup=True)
+                                   warmup=True,
+                                   scenario=scenario_stack(canonical))
         return gh
 
     def submit(self, spec, deadline_s=None):
@@ -178,8 +192,12 @@ class SimulationService:
         gh = geometry_hash(canonical)
         deadline = (t0 + float(deadline_s)
                     if deadline_s is not None else None)
-
+        label = stack_label(canonical.get("scenarios", []))
         with self._cond:
+            # traffic profile: every spec-valid submit counts, whatever
+            # its outcome (cache hit / coalesced / queued / rejected)
+            self.scenario_requests[label] = (
+                self.scenario_requests.get(label, 0) + 1)
             coalesced = self._coalesce(rid, deadline)
             if coalesced is not None:
                 return rid, coalesced
@@ -311,6 +329,7 @@ class SimulationService:
                 "rejected": self.rejected,
                 "expired": self.expired,
                 "cache_hits": self.cache_hits,
+                "scenario_requests": dict(self.scenario_requests),
             }
         out["stages"] = self.timers.snapshot()
         out["programs"] = self.registry.stats()
@@ -385,8 +404,11 @@ class SimulationService:
         if not self.registry.known(gh):
             cfg, profiles, noise_norm = build_geometry(batch[0].canonical)
             self.registry.register(gh, cfg, profiles, noise_norm,
-                                   warmup=True)
+                                   warmup=True,
+                                   scenario=scenario_stack(
+                                       batch[0].canonical))
         _, _, noise_norm = self.registry.geometry(gh)
+        stack = self.registry.scenario_of(gh)
         width = self.registry.bucket_width(len(batch))
         idx = [i % len(batch) for i in range(width)]  # pad: wrap rows
         keys = jnp.stack([self._request_key(batch[i].canonical,
@@ -398,12 +420,24 @@ class SimulationService:
             np.float32)
         nulls = np.asarray([batch[i].canonical["null_frac"] for i in idx],
                            np.float32)
+        sc = None
+        if stack is not None:
+            sc = np.asarray(
+                [scenario_param_vector(batch[i].canonical) for i in idx],
+                np.float32)
         self.timers.add("batch", time.perf_counter() - t0)
 
         t0 = time.perf_counter()
         out = np.asarray(
-            self.registry.execute(gh, width, keys, dms, norms, nulls))
-        self.timers.add("compute", time.perf_counter() - t0)
+            self.registry.execute(gh, width, keys, dms, norms, nulls,
+                                  sc=sc))
+        compute_s = time.perf_counter() - t0
+        self.timers.add("compute", compute_s)
+        if stack is not None:
+            # attribute this batch's device time to each enabled effect
+            # (overlapping by design — excluded from the bottleneck pick)
+            for name in stack.names():
+                self.timers.add(f"effect:{name}", compute_s)
 
         t0 = time.perf_counter()
         now = time.perf_counter()

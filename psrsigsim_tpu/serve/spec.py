@@ -28,9 +28,12 @@ from __future__ import annotations
 import hashlib
 import json
 
+from ..scenarios.registry import EFFECT_ORDER, EFFECTS, parse_stack
+
 __all__ = ["SpecError", "canonicalize", "spec_hash", "geometry_hash",
            "geometry_fields", "build_geometry", "REQUEST_FIELDS",
-           "GEOMETRY_FIELDS"]
+           "GEOMETRY_FIELDS", "SCENARIO_FIELD", "SCENARIO_PARAM_FIELDS",
+           "scenario_stack", "scenario_param_vector"]
 
 
 class SpecError(ValueError):
@@ -71,7 +74,30 @@ REQUEST_FIELDS = {
     "null_frac": (float, 0.0, (0.0, 1.0)),
 }
 
-_ALL_FIELDS = {**GEOMETRY_FIELDS, **REQUEST_FIELDS}
+#: the scenario-selection geometry field: a list of effect labels
+#: (``"scintillation"``, ``"rfi"``, ``"single_pulse[:mode]"``).  It is
+#: PROGRAM-SHAPING (part of the geometry hash): which effects trace is a
+#: static compile-time choice, which is what keeps scenario-free
+#: requests bit-identical to the pre-scenario pipeline.  Absent/empty ⇒
+#: the key never enters the canonical spec, so every pre-scenario spec
+#: keeps its exact hash (= cache address = PRNG fold).
+SCENARIO_FIELD = "scenarios"
+
+#: per-request scenario parameters, one field per registered effect
+#: parameter (psrsigsim_tpu.scenarios registry is the single schema
+#: source).  Traced per request — free to vary inside a batch — but only
+#: VALID (and only canonicalized, defaults included) when the owning
+#: effect is enabled in ``scenarios``: a parameter for a disabled effect
+#: is rejected loudly rather than silently ignored and mis-cached.
+SCENARIO_PARAM_FIELDS = {
+    p.name: (float, p.default, (p.lo, p.hi))
+    for n in EFFECT_ORDER for p in EFFECTS[n].params
+}
+_PARAM_EFFECT = {p.name: n for n in EFFECT_ORDER
+                 for p in EFFECTS[n].params}
+
+_ALL_FIELDS = {**GEOMETRY_FIELDS, **REQUEST_FIELDS,
+               **SCENARIO_PARAM_FIELDS}
 
 
 def canonicalize(spec):
@@ -82,12 +108,33 @@ def canonicalize(spec):
     if not isinstance(spec, dict):
         raise SpecError([f"spec must be a JSON object, got {type(spec).__name__}"])
     errors = []
-    unknown = sorted(set(spec) - set(_ALL_FIELDS))
+    unknown = sorted(set(spec) - set(_ALL_FIELDS) - {SCENARIO_FIELD})
     if unknown:
         errors.append(f"unknown field(s) {unknown}; valid fields: "
-                      f"{sorted(_ALL_FIELDS)}")
+                      f"{sorted(_ALL_FIELDS) + [SCENARIO_FIELD]}")
+    stack = None
+    if SCENARIO_FIELD in spec:
+        raw = spec[SCENARIO_FIELD]
+        if (not isinstance(raw, (list, tuple))
+                or not all(isinstance(x, str) for x in raw)):
+            errors.append(f"{SCENARIO_FIELD}: expected a list of effect "
+                          f"labels, got {raw!r}")
+        else:
+            try:
+                stack = parse_stack(raw)
+            except ValueError as err:
+                errors.append(f"{SCENARIO_FIELD}: {err}")
+    enabled_params = set(stack.param_names()) if stack is not None else set()
     out = {}
     for name, (cast, default, (lo, hi)) in _ALL_FIELDS.items():
+        if name in SCENARIO_PARAM_FIELDS and name not in enabled_params:
+            if name in spec:
+                errors.append(
+                    f"{name}: requires effect "
+                    f"{_PARAM_EFFECT[name]!r} enabled in "
+                    f"'{SCENARIO_FIELD}' (a parameter for a disabled "
+                    "effect would be silently dead physics)")
+            continue
         if name in spec:
             raw = spec[name]
             if isinstance(raw, bool) or isinstance(raw, (list, dict)):
@@ -112,6 +159,8 @@ def canonicalize(spec):
             errors.append(f"{name}: {val!r} outside [{lo}, {hi}]")
             continue
         out[name] = val
+    if stack is not None:
+        out[SCENARIO_FIELD] = stack.describe()
     if errors:
         raise SpecError(errors)
     return out
@@ -131,8 +180,28 @@ def spec_hash(canonical):
 
 
 def geometry_fields(canonical):
-    """The geometry-only subset of a canonical spec."""
-    return {k: canonical[k] for k in GEOMETRY_FIELDS}
+    """The geometry-only subset of a canonical spec (the ``scenarios``
+    selection is program-shaping, so it rides along when present)."""
+    g = {k: canonical[k] for k in GEOMETRY_FIELDS}
+    if SCENARIO_FIELD in canonical:
+        g[SCENARIO_FIELD] = canonical[SCENARIO_FIELD]
+    return g
+
+
+def scenario_stack(canonical):
+    """The static :class:`~psrsigsim_tpu.scenarios.ScenarioStack` of a
+    canonical spec (None for scenario-free specs)."""
+    return parse_stack(canonical.get(SCENARIO_FIELD))
+
+
+def scenario_param_vector(canonical):
+    """The request's traced scenario-parameter row, ordered by the
+    stack's ``param_names()`` (empty tuple for scenario-free specs).
+    Canonicalization guarantees every enabled parameter is present."""
+    stack = scenario_stack(canonical)
+    if stack is None:
+        return ()
+    return tuple(float(canonical[n]) for n in stack.param_names())
 
 
 def geometry_hash(canonical):

@@ -148,9 +148,10 @@ def _tile_periodic(prof, nsamp):
     return jnp.tile(prof, (1, reps))[:, :nsamp]
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "scenario"))
 def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
-                  extra_delays_ms=None, null_frac=None):
+                  extra_delays_ms=None, null_frac=None, scenario=None,
+                  scenario_params=None):
     """One fold-mode observation: synthesis + dispersion + radiometer noise.
 
     Args:
@@ -191,6 +192,21 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
             width; what matters for serving is that the SAME program
             handles every request, which is what makes results
             batching-invariant).
+        scenario: optional STATIC
+            :class:`~psrsigsim_tpu.scenarios.ScenarioStack` (hashable;
+            jit-static) enabling registered physics effects —
+            scintillation gain screens, RFI injection, single-pulse
+            energy distributions.  ``None`` (default) compiles the
+            scenario-free program bit-identically to a build without the
+            scenario engine (the disabled-is-free invariant, pinned by
+            tests/test_scenarios.py's jaxpr-equality gate).
+        scenario_params: traced parameter vector ordered by
+            ``scenario.param_names()`` (or a name-keyed dict; missing
+            names take registry defaults).  Required semantics are the
+            scenario registry's: every draw keys off this observation's
+            key on the effect's own RNG stage, so results are
+            bit-identical across chunk sizes, mesh shapes, and serving
+            bucket widths.
 
     Returns:
         ``(Nchan, nsub*Nph)`` float32 block (unclipped — clipping belongs to
@@ -198,14 +214,24 @@ def fold_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None, chan_ids=None,
     """
     return _fold_core(key, dm, noise_norm, cfg.nfold, cfg.draw_norm,
                       cfg.noise_df, profiles, cfg, freqs, chan_ids,
-                      extra_delays_ms, null_frac=null_frac)
+                      extra_delays_ms, null_frac=null_frac,
+                      scenario=scenario, scenario_params=scenario_params)
 
 
 def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
-               freqs, chan_ids, extra_delays_ms, dt_ms=None, null_frac=None):
+               freqs, chan_ids, extra_delays_ms, dt_ms=None, null_frac=None,
+               scenario=None, scenario_params=None):
     """Shared fold-mode observation body (synthesis + dispersion + noise);
     pulsar parameters may be static (homogeneous path) or traced (hetero,
-    including the sample spacing ``dt_ms``)."""
+    including the sample spacing ``dt_ms``).
+
+    ``scenario``/``scenario_params`` (see :func:`fold_pipeline`): when a
+    stack is given, multiplicative effects (scintillation gains, single-
+    pulse energies) land on the synthesized pulse block BEFORE nulling
+    and noise, and additive effects (RFI) land AFTER the radiometer term
+    — the order a real receiver sees them.  With ``scenario=None`` none
+    of these branches trace: the compiled program is the pre-scenario
+    one, bit for bit."""
     kp = stage_key(key, "pulse")
     kn = stage_key(key, "noise")
     if freqs is None:
@@ -234,6 +260,19 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
         block = block * _chan_chi2(kp, chan_ids, nfold, nsamp) * draw_norm
         block = fourier_shift(block, delays_ms, dt=dt)
 
+    if scenario is not None and scenario:
+        # multiplicative scenario effects modulate the PULSE term only
+        # (scintillation is a propagation gain on the source; per-pulse
+        # energies are emission physics) — the radiometer noise below is
+        # untouched, exactly as the reference layers ism -> telescope
+        from ..scenarios.registry import apply_pulse_effects
+
+        block = apply_pulse_effects(
+            key, block, scenario, scenario_params, nsub=cfg.nsub,
+            nph=cfg.nph, freqs=freqs, fcent_mhz=cfg.meta.fcent_mhz,
+            sublen_s=nfold * cfg.period_s,
+            f_lo_mhz=cfg.meta.fcent_mhz - cfg.meta.bw_mhz / 2)
+
     if null_frac is not None:
         # per-subint nulling between synthesis and noise (the nulled
         # pulse vanishes; the radiometer keeps integrating) — op-for-op
@@ -246,7 +285,18 @@ def _fold_core(key, dm, noise_norm, nfold, draw_norm, noise_df, profiles, cfg,
 
     # radiometer noise — added after dispersion in the reference too
     # (telescope.observe runs after ism.disperse), so never shifted
-    return block + _chan_chi2(kn, chan_ids, noise_df, nsamp) * noise_norm
+    block = block + _chan_chi2(kn, chan_ids, noise_df, nsamp) * noise_norm
+
+    if scenario is not None and scenario:
+        # additive effects (RFI) ride ON TOP of the radiometer noise —
+        # amplitudes are in units of the mean noise level noise_df*norm
+        from ..scenarios.registry import apply_additive_effects
+
+        block = apply_additive_effects(
+            key, block, scenario, scenario_params, nsub=cfg.nsub,
+            nph=cfg.nph, chan_ids=chan_ids,
+            noise_level=noise_df * noise_norm)
+    return block
 
 
 def fold_pipeline_hetero(key, dm, noise_norm, nfold, draw_norm, profiles, cfg,

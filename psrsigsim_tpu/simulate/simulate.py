@@ -292,9 +292,14 @@ class Simulation:
             self.signal._dm = make_quant(self.dm, "pc/cm^3")
         return self
 
-    def to_ensemble(self, mesh=None):
+    def to_ensemble(self, mesh=None, scenario=None):
         """Bridge to the sharded Monte-Carlo runner: same configuration, one
-        jitted pipeline, vmapped + mesh-sharded (TPU-native extension)."""
+        jitted pipeline, vmapped + mesh-sharded (TPU-native extension).
+
+        ``scenario``: optional list of scenario-effect labels (or a
+        :class:`~psrsigsim_tpu.scenarios.ScenarioStack`) enabling
+        registered in-graph physics effects on every program the
+        ensemble compiles — see :mod:`psrsigsim_tpu.scenarios`."""
         from ..parallel.ensemble import FoldEnsemble
 
         # the ensemble's PSRFITS exit path fits polycos: make sure they
@@ -305,7 +310,7 @@ class Simulation:
         self._activate_ephemeris()
         self.init_all()
         ens = FoldEnsemble(self.signal, self.pulsar, self.tscope,
-                           self.system_name, mesh=mesh)
+                           self.system_name, mesh=mesh, scenario=scenario)
         ens.ephemeris_source = self._ephemeris
         return ens
 

@@ -40,6 +40,15 @@ STAGES = {
     # stage, so a served result depends only on the request's content —
     # never on which batch, bucket width, or process executed it
     "serve": 7,
+    # scenario-engine effect stages (psrsigsim_tpu.scenarios): each
+    # registered effect draws from its own stage folded off the
+    # observation/trial/request key, so enabling one effect never
+    # perturbs another effect's stream — or the pulse/noise streams —
+    # for the same key.  "scint" (4, reserved above since round 1) is
+    # the scintillation gain-screen stage; these two cover RFI injection
+    # and single-pulse/transient energy draws.
+    "rfi": 8,
+    "transient": 9,
 }
 
 

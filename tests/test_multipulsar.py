@@ -70,16 +70,22 @@ class TestMultiPulsarEnsemble:
         a, b = np.asarray(out[0]), np.asarray(out[1])
         assert not np.allclose(a, b)
 
-        # with noise off, the folded mean profiles carry each pulsar's own
-        # width: pulsar 1 (width 0.06) shows more bins above half-max than
-        # pulsar 0 (width 0.03)
+        # with noise off, the folded profiles carry each pulsar's own
+        # width: pulsar 1 (width 0.06) shows more bins above half-max
+        # than pulsar 0 (width 0.03).  Measured PER CHANNEL — at these
+        # DMs the dispersion delay wraps several pulse periods, so a
+        # channel-averaged profile overlays shifted pulse copies and its
+        # half-max count reflects the overlap pattern, not the width
         quiet = [(cfg, prof, 0.0, dm) for cfg, prof, _, dm in workloads]
         ens_q = MultiPulsarFoldEnsemble(quiet, mesh=make_mesh((8, 1)))
         out_q = ens_q.run(epochs=2, seed=0)
         widths = []
         for arr in (np.asarray(out_q[0]), np.asarray(out_q[1])):
-            prof = arr.mean(axis=(0, 1)).reshape(2, -1).mean(0)
-            widths.append(np.sum(prof > (prof.min() + prof.max()) / 2))
+            chans = arr.mean(axis=0)               # (Nchan, nsub*nph)
+            chans = chans.reshape(chans.shape[0], 2, -1).mean(axis=1)
+            half = (chans.min(axis=1) + chans.max(axis=1)) / 2
+            widths.append(np.median(
+                np.sum(chans > half[:, None], axis=1)))
         assert widths[1] > widths[0]
 
     def test_mesh_invariance(self, workloads):

@@ -69,13 +69,17 @@ class TestObsSeqEnsemble:
         for shape in ((4, 2), (2, 4), (8, 1)):
             run = seq_sharded_search_ensemble(cfg, make_obs_seq_mesh(shape))
             outs[shape] = np.asarray(run(keys, dms, norms, profiles))
-        # same seq width -> bit-identical ((4,2) vs (2,4)); a different
-        # seq width changes the CPU FFT batch width (last-ulp accumulation
-        # ~ rms * eps * sqrt(nsamp); on TPU all three match exactly)
+        # draw streams are bit-identical by keying; any mesh reshape
+        # changes a LOCAL batch width ((4,2) vs (2,4) moves the per-shard
+        # obs count, (8,1) the seq width), and the CPU FFT backend may
+        # vectorize a different batch width to a different last ulp
+        # (~ rms * eps * sqrt(nsamp); on TPU all three match exactly) —
+        # the same caveat test_multipulsar.test_mesh_invariance and
+        # run_quantized document, so compare to float32 ulp throughout
         base = outs[(4, 2)]
-        assert np.array_equal(base, outs[(2, 4)])
-        assert np.allclose(base, outs[(8, 1)], rtol=2e-6,
-                           atol=5e-3 * base.std())
+        for shape in ((2, 4), (8, 1)):
+            assert np.allclose(base, outs[shape], rtol=2e-6,
+                               atol=5e-3 * base.std()), shape
 
     @needs8
     def test_matches_1d_seq_pipeline_per_obs(self):

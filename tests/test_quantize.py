@@ -171,20 +171,59 @@ class TestEnsembleQuantized:
 
     @needs8
     def test_quantizer_adds_no_mesh_dependence(self):
-        # host-side quantization of each mesh's float output reproduces that
-        # mesh's device bytes EXACTLY — any cross-mesh code flip comes from
-        # the float FFT, never from the export kernel
-        for shape in [(8, 1), (2, 4)]:
-            ens, _, _ = _ensemble(mesh_shape=shape)
-            floats = ens.run(n_obs=2, seed=11)
-            data, scl, offs = ens.run_quantized(n_obs=2, seed=11)
-            for b in range(2):
-                qh, sh, oh = subint_quantize(
-                    floats[b], ens.cfg.nsub, ens.cfg.nph
-                )
+        # the export kernel itself is execution-context-free: ONE fixed
+        # float block quantizes to byte-identical codes standalone,
+        # vmapped, and inside shard_map programs over different mesh
+        # shapes — any cross-mesh code flip in the full pipeline comes
+        # from the float FFT, never from the quantizer.  (This XLA CPU
+        # build drops lax.optimization_barrier during compilation, so
+        # the fold floats of two differently-shaped programs can differ
+        # by a last ulp — the quantizer is gated on a FIXED input, the
+        # float path by test_bit_reproducible_across_mesh_shapes.)
+        from jax.sharding import PartitionSpec as P
+
+        from psrsigsim_tpu.parallel.mesh import CHAN_AXIS, OBS_AXIS, \
+            make_mesh
+        from psrsigsim_tpu.parallel.seqshard import shard_map
+
+        nsub, nbin, nchan, n_obs = 2, 1024, 8, 8
+        rng = np.random.RandomState(11)
+        blocks = np.float32(
+            rng.randn(n_obs, nchan, nsub * nbin) * 40.0 + 15.0)
+        # a constant row exercises the span==0 branch in every context
+        blocks[0, 3, :nbin] = 7.5
+
+        # reference: JITTED single-observation calls (eager mode skips
+        # XLA's algebraic rewrites and can differ in the scale column by
+        # a last ulp — what must agree is every COMPILED context, which
+        # is all the pipelines ever run)
+        single = jax.jit(lambda b: subint_quantize(b, nsub, nbin))
+        ref = [tuple(np.asarray(p) for p in single(jnp.asarray(b)))
+               for b in blocks]
+
+        batched = jax.jit(jax.vmap(
+            lambda b: subint_quantize(b, nsub, nbin)))(blocks)
+        for b in range(n_obs):
+            for k in range(3):
                 np.testing.assert_array_equal(
-                    np.asarray(qh), np.asarray(data[b])
-                )
+                    np.asarray(batched[k][b]), ref[b][k], strict=True)
+
+        for shape in [(8, 1), (2, 4)]:
+            mesh = make_mesh(shape)
+            prog = jax.jit(shard_map(
+                lambda x: jax.vmap(
+                    lambda b: subint_quantize(b, nsub, nbin))(x),
+                mesh=mesh,
+                in_specs=P(OBS_AXIS, CHAN_AXIS, None),
+                out_specs=(P(OBS_AXIS, None, CHAN_AXIS, None),
+                           P(OBS_AXIS, None, CHAN_AXIS),
+                           P(OBS_AXIS, None, CHAN_AXIS)),
+            ))
+            out = prog(jnp.asarray(blocks))
+            for b in range(n_obs):
+                for k in range(3):
+                    np.testing.assert_array_equal(
+                        np.asarray(out[k][b]), ref[b][k], strict=True)
 
 
 class TestQuantizedPSRFITS:

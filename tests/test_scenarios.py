@@ -1,0 +1,782 @@
+"""Scenario engine (psrsigsim_tpu/scenarios): registry, in-graph physics
+ops, and the three entry points (ensemble API, MC priors, serve specs).
+
+The load-bearing guarantees pinned here:
+
+* disabled is free — ``scenario=None`` traces the EXACT pre-scenario
+  program (jaxpr-equal; the registry hooks are never entered) and a
+  scenario-capable ensemble with an empty stack exports byte-identical
+  PSRFITS files to the pristine pre-scenario public API;
+* enabled is invariant — every registered effect produces bit-identical
+  results solo vs coalesced vs across serve bucket widths {1, 8, 32},
+  across ensemble chunk sizes {32, 128, 512}, and across mesh shapes,
+  because every draw keys off the observation/trial/request key via the
+  effect's own RNG stage folded by GLOBAL integers;
+* one declaration, three entry points — the same stack + parameters give
+  bit-identical physics whether they arrive as ``FoldEnsemble(scenario=)``,
+  MC prior knobs, or a serve spec's ``"scenarios"`` field (the MC trial
+  body vs ``fold_pipeline`` parity test is the cross-entry-point pin).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psrsigsim_tpu.mc import Fixed, MonteCarloStudy, Uniform
+from psrsigsim_tpu.ops import pulse_energies, rfi_levels, scint_gain
+from psrsigsim_tpu.parallel import FoldEnsemble, make_mesh
+from psrsigsim_tpu.pulsar import GaussProfile, Pulsar
+from psrsigsim_tpu.scenarios import (EFFECT_ORDER, EFFECTS, ScenarioStack,
+                                     default_params, parse_stack,
+                                     scenario_knobs, stack_from_knobs)
+from psrsigsim_tpu.signal import FilterBankSignal
+from psrsigsim_tpu.simulate import Simulation
+from psrsigsim_tpu.simulate.pipeline import fold_pipeline
+from psrsigsim_tpu.telescope import Backend, Receiver, Telescope
+from psrsigsim_tpu.utils import make_quant
+from psrsigsim_tpu.utils.rng import stage_key
+
+TEMPLATE = os.path.join(
+    os.path.dirname(__file__), "..", "data",
+    "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+
+N_DEV = len(jax.devices())
+needs8 = pytest.mark.skipif(N_DEV < 8, reason="needs 8 devices")
+
+#: every registered stack exercised by the invariance matrices: each
+#: effect solo (single_pulse in its default mode) plus the full pile-up
+SOLO_STACKS = ["scintillation", "rfi", "single_pulse"]
+ALL_STACK = ["scintillation", "rfi", "single_pulse:powerlaw"]
+
+#: non-default parameters so the invariance tests never ride a knob's
+#: do-nothing point (e.g. rfi probabilities high enough that a small
+#: batch is guaranteed contaminated cells)
+PARAMS = {"scint_dnu_d_mhz": 30.0, "scint_dt_d_s": 0.4, "scint_mod": 0.9,
+          "rfi_imp_prob": 0.5, "rfi_imp_snr": 8.0,
+          "rfi_nb_prob": 0.5, "rfi_nb_snr": 5.0,
+          "sp_sigma": 0.7, "sp_alpha": 2.0, "sp_amp": 12.0}
+
+
+def _params_for(stack):
+    names = set(parse_stack(stack).param_names())
+    return {k: v for k, v in PARAMS.items() if k in names}
+
+
+def _ensemble(scenario=None, mesh_shape=None, nchan=4, _legacy=False):
+    if mesh_shape is None:
+        mesh_shape = (min(8, N_DEV), 1)
+    sig = FilterBankSignal(1400, 400, Nsubband=nchan, sample_rate=0.2048,
+                           sublen=0.5, fold=True)
+    psr = Pulsar(0.005, 0.5, GaussProfile(width=0.05), name="SC")
+    sig._tobs = make_quant(1.0, "s")
+    sig._dm = make_quant(12.0, "pc/cm^3")
+    t = Telescope(20.0, area=5500.0, Tsys=35.0, name="S")
+    t.add_system("sys", Receiver(fcent=1400, bandwidth=400, name="R"),
+                 Backend(samprate=0.2048, name="B"))
+    if _legacy:
+        # the pre-scenario public signature, exactly as every pre-PR
+        # caller constructs an ensemble (no scenario kwarg at all)
+        return FoldEnsemble(sig, psr, t, "sys", mesh=make_mesh(mesh_shape))
+    return FoldEnsemble(sig, psr, t, "sys", mesh=make_mesh(mesh_shape),
+                        scenario=scenario)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_three_effects_registered(self):
+        assert set(EFFECT_ORDER) == {"scintillation", "rfi", "single_pulse"}
+        for name in EFFECT_ORDER:
+            eff = EFFECTS[name]
+            assert eff.params, name
+            assert eff.stage, name
+
+    def test_parse_stack_canonicalizes_order(self):
+        a = parse_stack(["single_pulse", "scintillation"])
+        b = parse_stack(["scintillation", "single_pulse:lognormal"])
+        assert a == b
+        assert a.names() == ("scintillation", "single_pulse")
+
+    def test_parse_stack_empty_is_none(self):
+        assert parse_stack(None) is None
+        assert parse_stack([]) is None
+        assert parse_stack(ScenarioStack(())) is None
+
+    def test_parse_stack_names_every_error(self):
+        with pytest.raises(ValueError) as err:
+            parse_stack(["bogus", "single_pulse:weird", "scintillation:x"])
+        msg = str(err.value)
+        assert "bogus" in msg and "weird" in msg and "takes no mode" in msg
+
+    def test_conflicting_modes_rejected(self):
+        with pytest.raises(ValueError, match="twice"):
+            parse_stack(["single_pulse:frb", "single_pulse:powerlaw"])
+
+    def test_labels_hide_default_mode(self):
+        assert parse_stack(["single_pulse"]).labels() == ["single_pulse"]
+        assert (parse_stack(["single_pulse:frb"]).labels()
+                == ["single_pulse:frb"])
+        assert parse_stack(ALL_STACK).label() == \
+            "scintillation+rfi+single_pulse:powerlaw"
+
+    def test_param_names_are_globally_unique(self):
+        names = scenario_knobs()
+        assert len(names) == len(set(names))
+        # and every one is a Monte-Carlo knob (the registry IS the
+        # prior table extension — new effect => new knobs, no plumbing)
+        from psrsigsim_tpu.mc.study import KNOBS
+
+        assert set(names) <= set(KNOBS)
+
+    def test_stack_from_knobs_inference(self):
+        st = stack_from_knobs(["dm", "scint_mod", "rfi_nb_prob"])
+        assert st.names() == ("scintillation", "rfi")
+        st = stack_from_knobs(["sp_alpha"])
+        assert st.entries == (("single_pulse", "powerlaw"),)
+        assert stack_from_knobs(["dm", "noise_scale"]) is None
+
+    def test_stack_from_knobs_ambiguous_mode_rejected(self):
+        with pytest.raises(ValueError, match="ambiguous"):
+            stack_from_knobs(["sp_sigma", "sp_alpha"])
+
+    def test_default_params_follow_registry(self):
+        st = parse_stack(["scintillation"])
+        assert default_params(st) == tuple(
+            p.default for p in EFFECTS["scintillation"].params)
+
+
+# ---------------------------------------------------------------------------
+# in-graph ops
+# ---------------------------------------------------------------------------
+
+
+class TestScintGain:
+    FREQS = np.linspace(1200.0, 1600.0, 16, dtype=np.float32)
+
+    def _gain(self, key=0, freqs=None, nsub=8, dnu=30.0, dt=0.4, m=1.0,
+              f_lo=1200.0):
+        f = self.FREQS if freqs is None else freqs
+        return np.asarray(scint_gain(
+            jax.random.key(key), jnp.asarray(f), nsub, jnp.float32(dnu),
+            jnp.float32(dt), jnp.float32(m), 1400.0, 0.5, f_lo_mhz=f_lo))
+
+    def test_shape_positive_and_deterministic(self):
+        g = self._gain()
+        assert g.shape == (16, 8) and (g > 0).all()
+        np.testing.assert_array_equal(g, self._gain())
+        assert not np.array_equal(g, self._gain(key=1))
+
+    def test_mod_zero_is_exactly_unity(self):
+        np.testing.assert_array_equal(self._gain(m=0.0), 1.0)
+
+    def test_unit_mean_statistic(self):
+        # many independent scintles (small dnu/dt): unit-mean exponential
+        g = self._gain(nsub=64, dnu=0.5, dt=0.01)
+        assert abs(g.mean() - 1.0) < 0.1
+
+    def test_scintle_correlation_structure(self):
+        # huge dnu/dt => the whole band/time plane is ONE scintle: every
+        # channel and subint shares a single gain draw
+        g = self._gain(dnu=1e4, dt=1e6)
+        assert np.unique(g).size == 1
+        # small scintles => different cells draw independently
+        g = self._gain(dnu=0.5, dt=0.01)
+        assert np.unique(g).size > 64
+
+    def test_channel_shard_invariance(self):
+        # the mesh-shape handle: gains for a channel slab equal the
+        # corresponding rows of the full-band call ONLY because the cell
+        # origin is the passed global band floor, not min(shard freqs)
+        full = self._gain()
+        lo, hi = self._gain(freqs=self.FREQS[:8]), \
+            self._gain(freqs=self.FREQS[8:])
+        np.testing.assert_array_equal(np.vstack([lo, hi]), full)
+
+    def test_degenerate_params_stay_finite(self):
+        # dnu_d -> 0 explodes the scintle count; the cell clip keeps the
+        # int32 fold in range instead of overflowing
+        g = self._gain(dnu=1e-30, dt=1e-30)
+        assert np.isfinite(g).all()
+
+
+class TestRfiLevels:
+    def _levels(self, key=0, chan_ids=None, nsub=8, ip=0.5, isnr=8.0,
+                nprob=0.5, nsnr=5.0):
+        cids = np.arange(16) if chan_ids is None else chan_ids
+        lvl, mask = rfi_levels(
+            jax.random.key(key), jnp.asarray(cids), nsub,
+            jnp.float32(ip), jnp.float32(isnr), jnp.float32(nprob),
+            jnp.float32(nsnr))
+        return np.asarray(lvl), np.asarray(mask)
+
+    def test_shapes_determinism_and_mask_consistency(self):
+        lvl, mask = self._levels()
+        assert lvl.shape == mask.shape == (16, 8)
+        np.testing.assert_array_equal(lvl, self._levels()[0])
+        # the truth mask IS where the injection landed
+        assert (lvl[mask] > 0).all()
+        np.testing.assert_array_equal(lvl[~mask], 0.0)
+
+    def test_probability_edges(self):
+        lvl, mask = self._levels(ip=0.0, nprob=0.0)
+        assert not mask.any() and not lvl.any()
+        lvl, mask = self._levels(ip=1.0, nprob=1.0)
+        assert mask.all() and (lvl > 0).all()
+
+    def test_impulsive_is_broadband_narrowband_is_persistent(self):
+        lvl, mask = self._levels(nprob=0.0, ip=0.5)
+        # bursts hit every channel of their subint identically
+        assert mask.any()
+        np.testing.assert_array_equal(mask, mask[:1].repeat(16, axis=0))
+        np.testing.assert_array_equal(lvl, lvl[:1].repeat(16, axis=0))
+        lvl, mask = self._levels(ip=0.0, nprob=0.5)
+        # tones are constant in time on their channel
+        assert mask.any()
+        np.testing.assert_array_equal(mask, mask[:, :1].repeat(8, axis=1))
+
+    def test_global_chan_id_shard_invariance(self):
+        full, fmask = self._levels()
+        part, pmask = self._levels(chan_ids=np.arange(16)[10:])
+        np.testing.assert_array_equal(part, full[10:])
+        np.testing.assert_array_equal(pmask, fmask[10:])
+
+
+class TestPulseEnergies:
+    def _e(self, mode, param, key=0, nsub=4096):
+        return np.asarray(pulse_energies(
+            jax.random.key(key), nsub, mode, jnp.float32(param)))
+
+    def test_lognormal_unit_mean(self):
+        e = self._e("lognormal", 0.5)
+        assert (e > 0).all() and abs(e.mean() - 1.0) < 0.05
+        # sigma = 0 => every pulse is exactly the mean pulse
+        np.testing.assert_array_equal(self._e("lognormal", 0.0), 1.0)
+
+    def test_powerlaw_unit_mean_with_giant_tail(self):
+        e = self._e("powerlaw", 2.5)
+        assert (e > 0).all() and abs(e.mean() - 1.0) < 0.1
+        # the Pareto tail: rare pulses far above the mean
+        assert e.max() > 5.0
+        # alpha below the valid range is clipped, not NaN
+        assert np.isfinite(self._e("powerlaw", 0.5)).all()
+
+    def test_frb_exactly_one_burst(self):
+        e = self._e("frb", 12.0, nsub=64)
+        assert (e > 0).sum() == 1
+        assert e.sum() == np.float32(12.0)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown single-pulse mode"):
+            pulse_energies(jax.random.key(0), 4, "gaussian", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# disabled is free — the baseline-identity half of the acceptance pin
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledIsFree:
+    def test_scenario_none_is_jaxpr_identical(self):
+        """The zero-trace-cost gate: with ``scenario=None`` the pipeline
+        jaxpr is IDENTICAL to one traced through the pre-scenario call
+        signature, and an enabled stack strictly grows it."""
+        ens = _ensemble(_legacy=True)
+        cfg, prof = ens.cfg, jnp.asarray(ens._profiles)
+
+        def pre(key, dm, nn):
+            return fold_pipeline(key, dm, nn, prof, cfg)
+
+        def off(key, dm, nn):
+            return fold_pipeline(key, dm, nn, prof, cfg, scenario=None,
+                                 scenario_params=None)
+
+        st = parse_stack(["scintillation"])
+        sp = jnp.asarray(default_params(st), jnp.float32)
+
+        def on(key, dm, nn):
+            return fold_pipeline(key, dm, nn, prof, cfg, scenario=st,
+                                 scenario_params=sp)
+
+        args = (jax.random.key(0), jnp.float32(12.0), jnp.float32(0.1))
+        j_pre = jax.make_jaxpr(pre)(*args)
+        j_off = jax.make_jaxpr(off)(*args)
+        j_on = jax.make_jaxpr(on)(*args)
+        assert str(j_pre) == str(j_off)
+
+        def n_eqns(jaxpr):
+            # fold_pipeline is jitted, so the outer jaxpr is one pjit
+            # equation; count recursively through call-like primitives
+            total = 0
+            for eq in jaxpr.eqns:
+                total += 1
+                for v in eq.params.values():
+                    inner = getattr(v, "jaxpr", None)
+                    if inner is not None:
+                        total += n_eqns(inner)
+            return total
+
+        assert n_eqns(j_on.jaxpr) > n_eqns(j_pre.jaxpr)
+
+    def test_registry_hooks_never_entered_when_disabled(self, monkeypatch):
+        from psrsigsim_tpu.scenarios import registry
+
+        def boom(*a, **k):  # pragma: no cover - the gate IS not-called
+            raise AssertionError("scenario hook entered with stack=None")
+
+        monkeypatch.setattr(registry, "apply_pulse_effects", boom)
+        monkeypatch.setattr(registry, "apply_additive_effects", boom)
+        ens = _ensemble(scenario=None)
+        out = np.asarray(ens.run(4, seed=0))
+        assert np.isfinite(out).all()
+
+    def test_disabled_export_matches_pristine_bytes(self, tmp_path):
+        """Satellite 3's byte-identity gate: a scenario-capable ensemble
+        with every effect disabled exports PSRFITS files byte-identical
+        to the pristine pre-scenario public API, under the pristine
+        manifest fingerprint (no scenario keys stamped)."""
+        from psrsigsim_tpu.io import export_ensemble_psrfits
+
+        d1, d2 = str(tmp_path / "pristine"), str(tmp_path / "off")
+        ens1 = _ensemble(_legacy=True)
+        p1 = export_ensemble_psrfits(ens1, 4, d1, TEMPLATE, ens1.pulsar,
+                                     seed=3, writers=1, chunk_size=2)
+        ens2 = _ensemble(scenario=[])
+        p2 = export_ensemble_psrfits(ens2, 4, d2, TEMPLATE, ens2.pulsar,
+                                     seed=3, writers=1, chunk_size=2)
+        assert len(p1) == len(p2) > 0
+        for a, b in zip(sorted(p1), sorted(p2)):
+            assert open(a, "rb").read() == open(b, "rb").read()
+        for d in (d1, d2):
+            with open(os.path.join(d, "export_manifest.json")) as f:
+                man = json.load(f)
+            assert "scenario" not in man
+            assert "scenario_params_sha256" not in man
+
+    def test_scenario_params_without_stack_rejected(self):
+        ens = _ensemble(scenario=None)
+        with pytest.raises(ValueError, match="without a scenario stack"):
+            ens.run(4, scenario_params={"scint_mod": 0.5})
+        with pytest.raises(ValueError, match="RFI"):
+            ens.run_quantized(4, return_rfi=True)
+
+
+# ---------------------------------------------------------------------------
+# ensemble entry point — chunk-size invariance for every registered effect
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module", params=SOLO_STACKS + ["+".join(ALL_STACK)],
+                ids=lambda s: s.replace("+", "-").replace(":", "_"))
+def effect_ensemble(request):
+    stack = request.param.split("+")
+    return _ensemble(scenario=stack), stack
+
+
+class TestEnsembleEntryPoint:
+    def test_effect_changes_output_and_stays_finite(self, effect_ensemble):
+        ens, stack = effect_ensemble
+        base = np.asarray(_ensemble(_legacy=True).run(4, seed=0))
+        out = np.asarray(ens.run(4, seed=0,
+                                 scenario_params=_params_for(stack)))
+        assert out.shape == base.shape
+        assert np.isfinite(out).all()
+        assert not np.array_equal(out, base)
+
+    def test_bit_identical_across_chunk_sizes_32_128_512(
+            self, effect_ensemble):
+        """The acceptance invariance, per registered effect: the SAME
+        160 observations stream bit-identically through chunk sizes
+        {32, 128, 512} (512 exercises the pad-past-n_obs path) and match
+        the one-dispatch ``run_quantized`` bytes."""
+        ens, stack = effect_ensemble
+        n_obs, sp = 160, _params_for(stack)
+        outs = {}
+        for cs in (32, 128, 512):
+            parts = [blk for _, blk in ens.iter_chunks(
+                n_obs, chunk_size=cs, seed=5, quantized=True,
+                scenario_params=sp)]
+            outs[cs] = tuple(
+                np.concatenate([p[k] for p in parts]) for k in range(3))
+        whole = ens.run_quantized(n_obs, seed=5, scenario_params=sp)
+        for cs in (128, 512):
+            for a, b in zip(outs[cs], outs[32]):
+                np.testing.assert_array_equal(a, b, strict=True)
+        for a, b in zip(np.asarray(whole[0]), outs[32][0]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_per_obs_parameter_arrays(self, effect_ensemble):
+        """A (n_obs,) parameter array gives each observation its own
+        physics — rows with the knob at its do-nothing point match the
+        all-default run, rows with it engaged differ."""
+        ens, stack = effect_ensemble
+        knob, off_val, on_val = {
+            "scintillation": ("scint_mod", 0.0, 1.0),
+            "rfi": ("rfi_imp_prob", 0.0, 1.0),
+            "single_pulse": ("sp_sigma", 0.0, 1.0),
+        }[stack[0].partition(":")[0]]
+        # neutralize every OTHER effect so the probed knob owns the diff
+        neutral = {k: 0.0 for k in
+                   ("scint_mod", "rfi_imp_prob", "rfi_nb_prob", "sp_sigma")
+                   if k in ens.scenario.param_names() and k != knob}
+        col = np.asarray([off_val, on_val, off_val, on_val], np.float32)
+        mixed = np.asarray(ens.run(4, seed=2,
+                                   scenario_params={**neutral, knob: col}))
+        flat = np.asarray(ens.run(4, seed=2,
+                                  scenario_params={**neutral,
+                                                   knob: off_val}))
+        np.testing.assert_array_equal(mixed[0], flat[0])
+        assert not np.array_equal(mixed[1], flat[1])
+
+    def test_scenario_param_validation(self, effect_ensemble):
+        ens, _ = effect_ensemble
+        with pytest.raises(ValueError, match="unknown scenario parameter"):
+            ens.run(4, scenario_params={"bogus_knob": 1.0})
+        with pytest.raises(ValueError, match="shape"):
+            ens.run(4, scenario_params={
+                ens.scenario.param_names()[0]: np.zeros(3)})
+
+    @needs8
+    def test_mesh_shape_bit_identity(self, effect_ensemble):
+        _, stack = effect_ensemble
+        sp = _params_for(stack)
+        a = _ensemble(scenario=stack, mesh_shape=(8, 1)).run(
+            8, seed=0, scenario_params=sp)
+        b = _ensemble(scenario=stack, mesh_shape=(2, 4)).run(
+            8, seed=0, scenario_params=sp)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestRfiMaskFlow:
+    @pytest.fixture(scope="class")
+    def rfi_ens(self):
+        return _ensemble(scenario=["rfi"])
+
+    def test_run_quantized_returns_ground_truth(self, rfi_ens):
+        sp = _params_for(["rfi"])
+        d, s, o, fin, mask = rfi_ens.run_quantized(
+            8, seed=0, return_finite=True, return_rfi=True,
+            scenario_params=sp)
+        mask = np.asarray(mask)
+        assert mask.shape == (8, rfi_ens.cfg.meta.nchan, rfi_ens.cfg.nsub)
+        assert mask.dtype == bool
+        assert mask.any()           # prob 0.5 on 8 obs: astronomically sure
+        assert np.asarray(fin).all()
+
+    def test_mask_marks_the_contaminated_cells(self, rfi_ens):
+        """The truth mask is REAL ground truth: masked (chan, subint)
+        cells carry the injected power — same observation re-run with
+        injection off differs exactly on masked cells."""
+        sp = dict(_params_for(["rfi"]), rfi_imp_snr=50.0, rfi_nb_snr=50.0)
+        on = np.asarray(rfi_ens.run(4, seed=1, scenario_params=sp))
+        off = np.asarray(rfi_ens.run(
+            4, seed=1, scenario_params=dict(sp, rfi_imp_prob=0.0,
+                                            rfi_nb_prob=0.0)))
+        _, _, _, mask = rfi_ens.run_quantized(
+            4, seed=1, scenario_params=sp, return_rfi=True)
+        mask = np.asarray(mask)
+        nsub, nph = rfi_ens.cfg.nsub, rfi_ens.cfg.nph
+        diff = (on != off).reshape(4, -1, nsub, nph).any(axis=-1)
+        np.testing.assert_array_equal(diff, mask)
+
+    def test_iter_chunks_rfi_mask_matches(self, rfi_ens):
+        sp = _params_for(["rfi"])
+        _, _, _, ref = rfi_ens.run_quantized(8, seed=0, return_rfi=True,
+                                             scenario_params=sp)
+        parts = [blk[-1] for _, blk in rfi_ens.iter_chunks(
+            8, chunk_size=4, seed=0, quantized=True, rfi_mask=True,
+            scenario_params=sp)]
+        np.testing.assert_array_equal(np.concatenate(parts),
+                                      np.asarray(ref))
+
+    def test_supervised_export_journals_provenance(self, tmp_path):
+        """The labeled-dataset exit: a supervised RFI export lands the
+        contamination record in the manifest and the fsync'd journal."""
+        from psrsigsim_tpu.runtime import supervised_export
+
+        ens = _ensemble(scenario=["rfi"])
+        out = str(tmp_path / "rfi_run")
+        res = supervised_export(
+            ens, 4, out, TEMPLATE, ens.pulsar, seed=0, writers=1,
+            chunk_size=2,
+            scenario_params=dict(_params_for(["rfi"]), rfi_imp_prob=1.0))
+        assert res.paths
+        with open(os.path.join(out, "export_manifest.json")) as f:
+            man = json.load(f)
+        assert man["rfi"]["obs_with_rfi"] == 4
+        assert man["rfi"]["contaminated_cells"] > 0
+        events = [json.loads(l) for l in
+                  open(os.path.join(out, "run_journal.jsonl"))]
+        rfi_ev = [e for e in events if e.get("e") == "rfi"]
+        assert sorted(i for e in rfi_ev for i in e["obs"]) == [0, 1, 2, 3]
+
+    def test_export_fingerprint_guards_scenario(self, tmp_path):
+        """Resuming a scenario export under DIFFERENT physics is refused
+        loudly, naming the scenario fields."""
+        from psrsigsim_tpu.io import export_ensemble_psrfits
+        from psrsigsim_tpu.io.export import ExportManifestError
+
+        ens = _ensemble(scenario=["rfi"])
+        out = str(tmp_path / "guard")
+        export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar, seed=0,
+                                writers=1, chunk_size=2,
+                                scenario_params={"rfi_imp_prob": 1.0})
+        with pytest.raises(ExportManifestError,
+                           match="scenario parameter content"):
+            export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar,
+                                    seed=0, writers=1, chunk_size=2,
+                                    resume="error",
+                                    scenario_params={"rfi_imp_prob": 0.5})
+
+
+# ---------------------------------------------------------------------------
+# Monte-Carlo entry point
+# ---------------------------------------------------------------------------
+
+SIM_CONFIG = {
+    "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+    "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+    "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+    "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+    "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+    "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+    "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+}
+SIM_SMALL = dict(SIM_CONFIG, Nchan=2, sample_rate=0.1024)
+
+
+def _study(priors, seed=3, config=SIM_CONFIG, **kw):
+    return MonteCarloStudy.from_simulation(
+        Simulation(psrdict=dict(config)), priors, seed=seed, **kw)
+
+
+class TestMCEntryPoint:
+    def test_stack_inferred_from_priors(self):
+        st = _study({"dm": Uniform(5.0, 20.0), "scint_mod": Fixed(0.8),
+                     "sp_alpha": Uniform(1.5, 3.0)})
+        assert st._scenario.entries == (("scintillation", ""),
+                                        ("single_pulse", "powerlaw"))
+        assert _study({"dm": Uniform(5.0, 20.0)})._scenario is None
+
+    def test_ambiguous_sp_mode_rejected(self):
+        with pytest.raises(ValueError, match="ambiguous"):
+            _study({"sp_sigma": Fixed(0.5), "sp_alpha": Fixed(2.0)})
+
+    def test_trial_matches_fold_pipeline_bitwise_per_effect(self):
+        """THE cross-entry-point pin: for each registered effect, an MC
+        trial with Fixed scenario priors is bit-identical to
+        ``fold_pipeline`` given the same key, stack, and parameters —
+        one declaration, identical physics at every entry."""
+        from psrsigsim_tpu.scenarios.registry import SP_MODE_KNOBS
+
+        for stack in SOLO_STACKS + ["+".join(ALL_STACK)]:
+            labels = stack.split("+")
+            st = parse_stack(labels)
+            sp = _params_for(labels)
+            mode = st.mode("single_pulse")
+            if mode is not None:
+                # priors may declare only ONE sp mode-selector knob (the
+                # stack-inference ambiguity guard); keep the mode's own
+                keep = {m: k for k, m in SP_MODE_KNOBS.items()}[mode]
+                sp = {k: v for k, v in sp.items()
+                      if k not in SP_MODE_KNOBS or k == keep}
+            study = _study({"dm": Fixed(12.5),
+                            **{k: Fixed(v) for k, v in sp.items()}},
+                           seed=7)
+            assert study._scenario == st
+            cfg = study.cfg
+            key = stage_key(jax.random.key(7), "user", 3)
+            freqs = jnp.asarray(cfg.meta.dat_freq_mhz(), jnp.float32)
+            chan_ids = jnp.arange(cfg.meta.nchan)
+            prof = jnp.asarray(study._profiles_np)
+
+            trial = jax.jit(lambda k, s=study, p=prof, f=freqs,
+                            c=chan_ids: s._trial_block(
+                                k, jnp.int32(3), p, f, c)[0])
+            ref = fold_pipeline(
+                key, jnp.float32(12.5), jnp.float32(study.noise_norm),
+                prof, cfg, freqs=freqs, chan_ids=chan_ids,
+                scenario=study._scenario, scenario_params=sp)
+            assert np.array_equal(np.asarray(trial(key)),
+                                  np.asarray(ref)), stack
+
+    def test_chunk_invariance_with_scenario_priors(self, tmp_path):
+        """{32, 128, 512} trial chunks with priors across ALL three
+        effects: bit-identical merged statistics and fingerprints."""
+        study = _study({"dm": Uniform(5.0, 20.0),
+                        "scint_mod": Uniform(0.2, 1.0),
+                        "rfi_imp_prob": Fixed(0.3),
+                        "sp_sigma": Uniform(0.1, 0.8)},
+                       config=SIM_SMALL, seed=5)
+        outs = []
+        for cs in (32, 128, 512):
+            res = study.run(512, chunk_size=cs,
+                            out_dir=str(tmp_path / f"c{cs}"))
+            outs.append((json.dumps(res.summary(), sort_keys=True),
+                         res.fingerprint, res.metrics))
+        for summary, fp, metrics in outs[1:]:
+            assert summary == outs[0][0]
+            assert fp == outs[0][1]
+            assert np.array_equal(metrics, outs[0][2])
+
+    def test_fingerprint_carries_scenario(self, tmp_path):
+        study = _study({"dm": Uniform(5.0, 20.0),
+                        "scint_mod": Fixed(0.5)}, config=SIM_SMALL)
+        fp = study.fingerprint(8)
+        assert fp["scenarios"] == ["scintillation"]
+        base = _study({"dm": Uniform(5.0, 20.0)}, config=SIM_SMALL)
+        assert "scenarios" not in base.fingerprint(8)
+
+
+# ---------------------------------------------------------------------------
+# serving entry point — bucket-width invariance for every registered effect
+# ---------------------------------------------------------------------------
+
+SERVE_SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05,
+    "seed": 3, "dm": 10.0,
+}
+
+
+def _scenario_spec(stack, **over):
+    spec = dict(SERVE_SPEC, scenarios=list(stack), **_params_for(stack))
+    spec.update(over)
+    return spec
+
+
+def _serve_once(spec, widths, n_strangers, window):
+    """Serve ``spec`` through a service restricted to ``widths`` beside
+    ``n_strangers`` same-geometry strangers; returns (bytes, metrics)."""
+    from psrsigsim_tpu.serve import SimulationService
+
+    svc = SimulationService(cache_dir=None, widths=widths,
+                            batch_window_s=window)
+    try:
+        svc.warmup(spec)
+        ids = [svc.submit(dict(spec, seed=100 + i, dm=12.0 + i))[0]
+               for i in range(n_strangers)]
+        rid, _ = svc.submit(spec)
+        out = svc.result(rid, timeout=300)
+        for i in ids:
+            svc.result(i, timeout=300)
+        svc.registry.assert_single_compile()    # retrace == 1 / geometry
+        return np.ascontiguousarray(out).tobytes(), svc.metrics()
+    finally:
+        svc.close()
+
+
+class TestServeSpec:
+    def test_scenarios_field_shapes_geometry(self):
+        from psrsigsim_tpu.serve import canonicalize, geometry_hash, \
+            spec_hash
+
+        base = canonicalize(SERVE_SPEC)
+        sc = canonicalize(_scenario_spec(["scintillation"]))
+        assert geometry_hash(base) != geometry_hash(sc)
+        assert spec_hash(base) != spec_hash(sc)
+        # pre-scenario specs canonicalize WITHOUT the key: their hashes
+        # (= cache addresses = PRNG folds) are untouched by this PR
+        assert "scenarios" not in base
+        assert all(not k.startswith(("scint_", "rfi_", "sp_"))
+                   for k in base)
+
+    def test_scenario_defaults_filled_and_bounded(self):
+        from psrsigsim_tpu.serve import SpecError, canonicalize
+
+        c = canonicalize(dict(SERVE_SPEC, scenarios=["rfi"]))
+        assert c["rfi_imp_prob"] == EFFECTS["rfi"].params[0].default
+        with pytest.raises(SpecError, match="rfi_imp_prob"):
+            canonicalize(dict(SERVE_SPEC, scenarios=["rfi"],
+                              rfi_imp_prob=2.0))
+
+    def test_param_for_disabled_effect_rejected(self):
+        from psrsigsim_tpu.serve import SpecError, canonicalize
+
+        with pytest.raises(SpecError, match="scint_mod.*scintillation"):
+            canonicalize(dict(SERVE_SPEC, scint_mod=0.5))
+        with pytest.raises(SpecError, match="sp_amp"):
+            canonicalize(dict(SERVE_SPEC, scenarios=["rfi"], sp_amp=3.0))
+
+    def test_mode_rides_the_label(self):
+        from psrsigsim_tpu.serve import canonicalize, geometry_hash
+
+        a = canonicalize(_scenario_spec(["single_pulse:frb"]))
+        b = canonicalize(_scenario_spec(["single_pulse:powerlaw"]))
+        assert a["scenarios"] == ["single_pulse:frb"]
+        assert geometry_hash(a) != geometry_hash(b)
+
+
+class TestServeEntryPoint:
+    @pytest.mark.parametrize("stack", [["scintillation"], ["rfi"],
+                                       ["single_pulse:powerlaw"]],
+                             ids=lambda s: s[0].replace(":", "_"))
+    def test_solo_vs_coalesced_bit_identical(self, stack):
+        """Bucket-width invariance per registered effect (widths 1 vs 8
+        with strangers; the {1,8,32} full matrix is the slow variant +
+        `make bench-scenarios`)."""
+        spec = _scenario_spec(stack)
+        solo, m1 = _serve_once(spec, (1,), 0, 0.0)
+        co8, m8 = _serve_once(spec, (8,), 5, 0.1)
+        assert solo == co8
+        label = "+".join(parse_stack(stack).labels())
+        assert m8["scenario_requests"] == {label: 6}
+
+    @pytest.mark.slow
+    def test_bucket_width_matrix_1_8_32(self):
+        """The full acceptance matrix for the pile-up stack: widths
+        {1, 8, 32}, solo vs coalesced, all byte-identical."""
+        spec = _scenario_spec(ALL_STACK)
+        solo, _ = _serve_once(spec, (1,), 0, 0.0)
+        co8, _ = _serve_once(spec, (8,), 6, 0.1)
+        co32, _ = _serve_once(spec, (32,), 20, 0.1)
+        assert solo == co8 == co32
+
+    def test_scenario_result_differs_from_base(self):
+        base, _ = _serve_once(dict(SERVE_SPEC), (1,), 0, 0.0)
+        sc, _ = _serve_once(_scenario_spec(["rfi"], rfi_imp_prob=1.0,
+                                           rfi_imp_snr=20.0), (1,), 0, 0.0)
+        assert base != sc
+
+    def test_effect_timers_and_counters_in_metrics(self):
+        _, m = _serve_once(_scenario_spec(["scintillation", "rfi"]),
+                           (1,), 0, 0.0)
+        assert m["scenario_requests"] == {"scintillation+rfi": 1}
+        assert m["stages"]["effect:scintillation_calls"] >= 1
+        assert m["stages"]["effect:rfi_calls"] >= 1
+        assert m["stages"]["effect:single_pulse_calls"] == 0
+        # attribution stages never win the bottleneck pick
+        assert not m["stages"]["bottleneck"].startswith("effect:")
+
+    def test_mixed_traffic_one_service(self):
+        """Base and scenario geometries share one service: separate
+        programs, separate counters, every result correct (byte-equal
+        to its solo service run)."""
+        from psrsigsim_tpu.serve import SimulationService
+
+        base_spec = dict(SERVE_SPEC)
+        sc_spec = _scenario_spec(["single_pulse:frb"])
+        solo_base, _ = _serve_once(base_spec, (1,), 0, 0.0)
+        solo_sc, _ = _serve_once(sc_spec, (1,), 0, 0.0)
+        svc = SimulationService(cache_dir=None, widths=(1,),
+                                batch_window_s=0.0)
+        try:
+            rb, _ = svc.submit(base_spec)
+            rs, _ = svc.submit(sc_spec)
+            got_b = np.ascontiguousarray(svc.result(rb, timeout=300))
+            got_s = np.ascontiguousarray(svc.result(rs, timeout=300))
+            assert got_b.tobytes() == solo_base
+            assert got_s.tobytes() == solo_sc
+            m = svc.metrics()
+            assert m["scenario_requests"] == {"base": 1,
+                                              "single_pulse:frb": 1}
+        finally:
+            svc.close()

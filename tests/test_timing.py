@@ -540,3 +540,82 @@ class TestHeteroPipelineGuard:
             fold_pipeline_hetero(
                 jax.random.key(0), jnp.float32(10.0), jnp.float32(0.1),
                 np.float32(10.0), jnp.float32(1.0), profiles, cfg)
+
+
+class TestFBSeries:
+    """FB-series orbital-frequency derivatives (FB0..FBn): the BTX-style
+    parameterization black-widow pulsars are fit with — one of the two
+    loud-rejection classes left after round 5, now evaluated directly as
+    the orbital-phase Taylor series (io/timing.py _binary_delay_at)."""
+
+    BASE = ("PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\n"
+            "F0 327.0\nPEPOCH 56000\nDM 10.0\n"
+            "TZRMJD 56000\nTZRFRQ 1400\nTZRSITE @\n")
+
+    def test_fb1_matches_equivalent_pbdot(self, tmp_path):
+        # PB/PBDOT and FB0/FB1 describe the same orbit to first order:
+        # FB0 = 1/PB_s, FB1 = -PBDOT/PB_s^2.  Note PBDOT here is SMALL
+        # enough (1e-10 > 1e-7? no: use explicit e-notation below) to
+        # dodge the TEMPO legacy 1e-12 unit heuristic.
+        pb_days = 0.2
+        pb_s = pb_days * 86400.0
+        pbdot = 4.0e-11  # s/s, below the 1e-7 legacy-unit threshold
+        fb0 = 1.0 / pb_s
+        fb1 = -pbdot / pb_s**2
+        orb = f"BINARY BT\nA1 0.05\nT0 56000.0\nECC 0.0\nOM 0.0\n"
+        p1 = tmp_path / "pbdot.par"
+        p1.write_text(self.BASE + orb + f"PB {pb_days}\nPBDOT {pbdot:e}\n")
+        p2 = tmp_path / "fb.par"
+        p2.write_text(self.BASE + orb + f"FB0 {fb0:.15e}\nFB1 {fb1:.15e}\n")
+        m1 = TimingModel.from_par(str(p1))
+        m2 = TimingModel.from_par(str(p2))
+        assert m2.fb_terms is not None and len(m2.fb_terms) == 2
+        t = np.linspace(56000.0, 56000.0 + 400.0, 600)
+        d1, d2 = m1.binary_delay(t), m2.binary_delay(t)
+        # identical physics, different arithmetic path: agree to well
+        # under the ~us differential budget of the whole timing model
+        assert np.max(np.abs(d1 - d2)) < 1e-8
+
+    def test_realistic_black_widow_par_accepted_strict(self, tmp_path):
+        # a PSR J2051-0827-style black widow: ELL1, 2.38 h orbit, FB0-FB2
+        # measured (values of the right order for that system).  Through
+        # round 5 this par raised UnsupportedTimingModelError; it must
+        # now build under strict=True and predict finite, orbit-periodic
+        # phase.
+        par = tmp_path / "bw.par"
+        par.write_text(
+            "PSR J2051-0827\nRAJ 20:51:07.5\nDECJ -08:27:37.7\n"
+            "F0 221.796283653\nF1 -6.26e-16\nPEPOCH 55000\nDM 20.745\n"
+            "BINARY ELL1\nA1 0.045072\nTASC 54091.034\n"
+            "EPS1 1.0e-5\nEPS2 -4.0e-5\n"
+            "FB0 1.1660653e-4\nFB1 3.3e-20\nFB2 -2.0e-27\n"
+            "TZRMJD 55000\nTZRFRQ 1400\nTZRSITE @\n"
+        )
+        m = TimingModel.from_par(str(par), strict=True)
+        assert m.fb_terms is not None and len(m.fb_terms) == 3
+        pb_s = 1.0 / m.fb_terms[0]
+        t = np.linspace(55000.0, 55000.0 + 3 * pb_s / 86400.0, 400)
+        d = m.binary_delay(t)
+        assert np.all(np.isfinite(d))
+        # Roemer amplitude ~ A1 = 0.045 lt-s, and one orbit apart the
+        # delay repeats to the FB1/FB2 drift (tiny over 3 orbits)
+        assert 0.5 * 0.045 < np.max(np.abs(d)) < 1.5 * 0.045
+        ph = m.phase(t)
+        assert np.all(np.isfinite(np.asarray(ph, np.float64)))
+
+    def test_fb1_without_fb0_rejected(self, tmp_path):
+        par = tmp_path / "nofb0.par"
+        par.write_text(self.BASE + "BINARY BT\nA1 0.05\nT0 56000.0\n"
+                       "PB 0.2\nFB1 1e-20\n")
+        with pytest.raises(ValueError, match="FB1\\+ .*without FB0"):
+            TimingModel.from_par(str(par))
+
+    def test_fb0_only_keeps_pb_path(self, tmp_path):
+        # FB0 alone (or with explicitly zero FB1) keeps the round-5
+        # PB-derived arithmetic: fb_terms stays None
+        par = tmp_path / "fb0.par"
+        par.write_text(self.BASE + "BINARY BT\nA1 0.05\nT0 56000.0\n"
+                       f"FB0 {1.0 / (0.2 * 86400.0):.15e}\nFB1 0.0\n")
+        m = TimingModel.from_par(str(par))
+        assert m.fb_terms is None
+        assert m.pb == pytest.approx(0.2)
