@@ -17,9 +17,13 @@
 # across bucket widths {1,8,32}, cache hits with zero device calls,
 # one compile per (geometry, width), clean drain, batched-vs-serial
 # throughput + latency percentiles (bench.py serve_smoke).
+# `make fleet-smoke` is the replicated-fleet gate: replica-kill failover
+# byte identity vs a solo run, zero committed cache artifacts lost,
+# per-replica single-compile, supervisor restart/recovery, and the
+# multi-process cache contention stress (bench.py fleet_smoke).
 
 .PHONY: lint test test-faults bench-export bench-mc serve-smoke \
-	bench-scenarios
+	bench-scenarios fleet-smoke
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -41,3 +45,6 @@ serve-smoke:
 
 bench-scenarios:
 	JAX_PLATFORMS=cpu python bench.py --scenario-smoke
+
+fleet-smoke:
+	JAX_PLATFORMS=cpu python bench.py --fleet-smoke

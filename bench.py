@@ -1490,6 +1490,110 @@ def serve_smoke():
     return {"metric": "serve_smoke", "invariant": True, **result, "ok": True}
 
 
+# ---------------------------------------------------------------------------
+# Config 9: replicated serving fleet (psrsigsim_tpu/serve fleet+router)
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet_runner(extra, timeout=600):
+    """Run tests/fleet_runner.py and return its one-line JSON verdict.
+    The chaos/stress proofs SIGKILL replicas and spawn server
+    subprocesses, so they cannot run inside the bench process itself."""
+    import subprocess
+
+    runner = os.path.join(REPO, "tests", "fleet_runner.py")
+    proc = subprocess.run(
+        [sys.executable, runner, *extra], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, timeout=timeout)
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    if not lines:
+        raise RuntimeError("fleet_runner produced no verdict line")
+    return json.loads(lines[-1])
+
+
+def time_fleet(n_replicas=None, n_requests=None):
+    """Config 9: fleet throughput vs a solo replica — the SAME request
+    stream through a consistent-hash router over N supervised replica
+    processes sharing one cache dir, vs one replica alone.  Separate
+    processes sidestep the GIL, so even on CPU the fleet can scale; on
+    one chip N replicas time-share the device, so this measures the
+    serving-path (HTTP + engine) headroom the fleet adds, not device
+    scaling."""
+    import shutil
+    import tempfile
+
+    if n_replicas is None:
+        n_replicas = int(os.environ.get("PSS_BENCH_FLEET_REPLICAS", "2"))
+    if n_requests is None:
+        n_requests = int(os.environ.get("PSS_BENCH_FLEET_REQUESTS", "16"))
+    out = tempfile.mkdtemp(prefix="pss_fleet_bench_")
+    try:
+        v = _run_fleet_runner(
+            ["--mode", "chaos", "--out", out, "--no-faults",
+             "--replicas", str(n_replicas),
+             "--requests", str(n_requests), "--threads", "4"])
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    if not v["ok"]:
+        raise RuntimeError(f"fleet bench verdict not ok: {v}")
+    return {
+        "replicas": n_replicas,
+        "n_requests": n_requests,
+        "solo_req_per_sec": v["solo_req_per_sec"],
+        "fleet_req_per_sec": v["fleet_req_per_sec"],
+        "fleet_over_solo": v["fleet_over_solo"],
+        "byte_identical": v["byte_identical"],
+        "per_replica": v["per_replica"],
+        "cache_entries": v["entries"],
+    }
+
+
+def fleet_smoke():
+    """Quick replicated-fleet gate (``make fleet-smoke``): (a) the chaos
+    proof — ``replica.kill`` SIGKILLs a routed replica mid-traffic, the
+    router fails over with the remaining deadline, the supervisor
+    restarts the corpse, and every accepted request completes with
+    bytes IDENTICAL to a solo single-replica run; (b) zero committed
+    cache artifacts lost or torn (verify re-hash over the shared dir
+    after drain, no leaked claims/temps); (c) every surviving replica
+    compiled each (geometry, width) program at most once (the
+    per-replica single-compile guard over the grown /healthz); (d) the
+    multi-process cache contention stress — N processes hammering one
+    cache dir commit exactly one artifact per hash, no torn reads, no
+    duplicate journal records."""
+    import shutil
+    import tempfile
+
+    out = tempfile.mkdtemp(prefix="pss_fleet_smoke_")
+    try:
+        chaos = _run_fleet_runner(
+            ["--mode", "chaos", "--out", os.path.join(out, "chaos"),
+             "--replicas", "2", "--requests", "6", "--kill-after", "2",
+             "--threads", "3"])
+        stress = _run_fleet_runner(
+            ["--mode", "cache-stress", "--out", os.path.join(out, "s"),
+             "--workers", "4", "--puts", "24", "--hashes", "8"])
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    assert chaos["byte_identical"], (
+        "fleet responses NOT byte-identical to the solo replica run: "
+        f"{chaos}")                                         # (a)
+    assert chaos["kill_fired"] >= 1 and chaos["failovers"] >= 1, chaos
+    assert chaos["restarts"] >= 1 and chaos["recovered"], (
+        f"killed replica was not restarted/recovered: {chaos}")
+    assert (chaos["lost_commits"] == 0 and not chaos["leaked_tmps"]
+            and not chaos["leaked_claims"]), (
+        f"committed cache artifacts lost/torn or claims leaked: {chaos}")  # (b)
+    assert chaos["entries"] == chaos["requests"], chaos
+    assert chaos["compile_ok"], (
+        f"a replica compiled a program more than once: {chaos}")  # (c)
+    assert chaos["ok"], chaos
+    assert stress["ok"], (
+        f"multi-process cache contention stress failed: {stress}")  # (d)
+    return {"metric": "fleet_smoke", "chaos": chaos, "stress": stress,
+            "ok": True}
+
+
 _SCENARIO_STACKS = ("scintillation", "rfi", "single_pulse",
                     "scintillation+rfi+single_pulse:powerlaw")
 
@@ -1788,6 +1892,8 @@ _COMPACT_FIELDS = (
     ("e2e_packed_obs_per_sec", "pobs_s", 1),
     ("batched_req_per_sec", "req_s", 1),
     ("serial_req_per_sec", "sreq_s", 1),
+    ("fleet_req_per_sec", "freq_s", 1),
+    ("fleet_over_solo", "fspd", 2),
     ("request_p99_s", "p99_s", 4),
     ("cache_hit_req_per_sec", "hit_s", 1),
     ("subint_encode_speedup", "enc_spd", 1),
@@ -1897,6 +2003,13 @@ def main():
         # + drain + retrace gates, with latency percentiles reported
         with contextlib.redirect_stdout(sys.stderr):
             result = serve_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
+    if "--fleet-smoke" in sys.argv[1:]:
+        # `make fleet-smoke`: replica-kill failover byte identity +
+        # zero-lost-commit + per-replica single-compile + cache stress
+        with contextlib.redirect_stdout(sys.stderr):
+            result = fleet_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
     if "--scenario-smoke" in sys.argv[1:]:
@@ -2072,6 +2185,16 @@ def _main():
         for name, eff in sc["effects"].items())
     log(f"config8_scenarios: base {1/sc['base_tpu_s_per_obs']:.1f} obs/s; "
         f"overhead {_sc_parts}; disabled_is_free={sc['disabled_is_free']}")
+    _checkpoint(detail)
+
+    # --- config 9: replicated serving fleet -----------------------------
+    flt = time_fleet()
+    detail["config9_fleet"] = flt
+    log(f"config9_fleet: {flt['replicas']} replicas "
+        f"{flt['fleet_req_per_sec']:.1f} req/s vs solo "
+        f"{flt['solo_req_per_sec']:.1f} req/s "
+        f"({flt['fleet_over_solo']:.2f}x; byte_identical="
+        f"{flt['byte_identical']}, per_replica {flt['per_replica']})")
     _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------

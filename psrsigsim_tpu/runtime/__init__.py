@@ -4,7 +4,10 @@
   run loop around the chunked ensemble -> PSRFITS export path
   (:func:`supervised_export` / :class:`RunSupervisor`): crash-safe
   journaled output with sha256-verified resume, in-graph NaN quarantine
-  with salted retry, and an append-only chunk journal + atomic cursor.
+  with salted retry, and an append-only chunk journal + atomic cursor —
+  plus :class:`ProcessSupervisor`, the keep-one-subprocess-alive loop
+  (restart with jittered backoff, bounded flapping) the serving fleet
+  builds its replica supervision on.
 - :mod:`~psrsigsim_tpu.runtime.retry` — capped exponential backoff
   shared by every self-healing loop (writer-pool respawn, retries).
 - :mod:`~psrsigsim_tpu.runtime.faults` — deterministic, explicitly-armed
@@ -17,7 +20,8 @@
 
 from .faults import FaultPlan
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
-from .supervisor import RunResult, RunSupervisor, supervised_export
+from .supervisor import (ProcessSupervisor, RunResult, RunSupervisor,
+                         supervised_export)
 from .telemetry import StageTimers
 
 __all__ = [
@@ -26,6 +30,7 @@ __all__ = [
     "RetriesExhausted",
     "StageTimers",
     "call_with_retry",
+    "ProcessSupervisor",
     "RunResult",
     "RunSupervisor",
     "supervised_export",

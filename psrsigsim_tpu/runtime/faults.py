@@ -56,6 +56,35 @@ point                     where it fires
                           saturated queue would, exercising the client-
                           visible backpressure path.  Config: ``times``
                           only.
+``replica.kill``          the fleet router
+                          (:class:`psrsigsim_tpu.serve.FleetRouter`),
+                          right BEFORE the ``after_requests``-th
+                          response would be produced — SIGKILLs the
+                          replica the request routed to (or the one
+                          named by ``replica``), so the forward that
+                          follows runs into the freshly dead socket:
+                          the hardest-ordering mid-traffic death for
+                          failover/restart proofs
+                          (tests/fleet_runner.py).  Config:
+                          ``{"after_requests": int, "replica": int}``;
+                          both optional (defaults: first request, the
+                          routed replica).
+``cache.contend``         :meth:`psrsigsim_tpu.serve.ResultCache.put`,
+                          between the artifact rename and the journal
+                          append — sleeps ``hold_s`` (default 0.05)
+                          INSIDE the claim-held/journal-absent window,
+                          widening exactly the race the cross-process
+                          commit discipline exists for so contention
+                          stress tests hit it reliably.  Config:
+                          ``{"hold_s": float}``.
+``route.blackhole``       the fleet router, before forwarding to the
+                          routed replica — raises ``ConnectionError``
+                          as if the replica's socket vanished (network
+                          partition without a process death),
+                          exercising the failover re-route path while
+                          the replica itself stays healthy.  Config:
+                          ``times`` / ``match`` (token is the replica
+                          id).
 ========================  ====================================================
 
 Arming is explicit and local: a :class:`FaultPlan` is built by a test and
@@ -80,7 +109,8 @@ import signal
 __all__ = ["FaultPlan", "should_fire", "crash_process", "POINTS"]
 
 POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
-          "run.kill", "mc.kill", "serve.kill", "serve.reject")
+          "run.kill", "mc.kill", "serve.kill", "serve.reject",
+          "replica.kill", "cache.contend", "route.blackhole")
 
 
 class FaultPlan:

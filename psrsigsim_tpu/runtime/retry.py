@@ -35,31 +35,58 @@ class RetriesExhausted(RuntimeError):
 
 
 class RetryPolicy:
-    """Capped exponential backoff schedule.
+    """Capped exponential backoff schedule, optionally jittered.
 
     ``delay(k)`` is the sleep before retry ``k`` (0-based):
     ``min(max_delay, base_delay * multiplier**k)``.  ``max_attempts``
     bounds the total number of attempts (first try included); the
     policy object is immutable and shareable across call sites.
+
+    ``jitter`` (0..1, default 0 = exactly the deterministic schedule)
+    spreads each delay uniformly over the bounded band
+    ``[d*(1-jitter), min(max_delay, d*(1+jitter))]`` around the
+    deterministic value ``d``.  A fleet of replicas/writers respawning
+    after a shared outage otherwise backs off in lockstep and
+    thundering-herds whatever shared resource (the cache lock, the
+    device) killed them in the first place; successive draws from each
+    process's own ``rng`` stream decorrelate the herd while the band
+    keeps every delay within a tested bound of the schedule.  ``rng`` is
+    an injectable zero-argument callable returning floats in ``[0, 1)``
+    (e.g. ``random.Random(seed).random``) so tests replay schedules
+    exactly; jitter without an rng falls back to a private
+    ``random.Random`` seeded from ``os.urandom``.
     """
 
     def __init__(self, max_attempts=3, base_delay=0.5, max_delay=30.0,
-                 multiplier=2.0):
+                 multiplier=2.0, jitter=0.0, rng=None):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if base_delay < 0 or max_delay < 0:
             raise ValueError("delays must be >= 0")
         if multiplier < 1.0:
             raise ValueError("multiplier must be >= 1")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
         self.max_attempts = int(max_attempts)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
         self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        if rng is None and self.jitter > 0.0:
+            import random
+
+            rng = random.Random().random
+        self._rng = rng
 
     def delay(self, retry_index):
         """Backoff before the ``retry_index``-th retry (0-based)."""
-        return min(self.max_delay,
-                   self.base_delay * self.multiplier ** retry_index)
+        d = min(self.max_delay,
+                self.base_delay * self.multiplier ** retry_index)
+        if self.jitter == 0.0 or self._rng is None:
+            return d
+        lo = d * (1.0 - self.jitter)
+        hi = min(self.max_delay, d * (1.0 + self.jitter))
+        return lo + self._rng() * (hi - lo)
 
     def delays(self):
         """The full schedule: one delay per retry (``max_attempts - 1``)."""
@@ -68,7 +95,7 @@ class RetryPolicy:
     def __repr__(self):
         return (f"RetryPolicy(max_attempts={self.max_attempts}, "
                 f"base_delay={self.base_delay}, max_delay={self.max_delay}, "
-                f"multiplier={self.multiplier})")
+                f"multiplier={self.multiplier}, jitter={self.jitter})")
 
 
 def call_with_retry(fn, policy=None, retry_on=(Exception,), on_retry=None,

@@ -24,10 +24,22 @@ The subsystem that turns the batch pipelines into a service
   — the stdlib ThreadingHTTPServer JSON API (``/simulate``,
   ``/status/<id>``, ``/result/<id>``, ``/healthz``, ``/metrics``) with
   graceful drain on SIGTERM.
+- :mod:`~psrsigsim_tpu.serve.fleet` — :class:`ReplicaFleet`: N
+  supervised server subprocesses over ONE shared cache dir,
+  health-checked via ``/healthz``, restarted with jittered backoff,
+  drained fleet-wide on SIGTERM, degraded gracefully below quorum.
+- :mod:`~psrsigsim_tpu.serve.router` — :class:`FleetRouter` /
+  ``make_router_server``: consistent ``spec_hash`` rendezvous routing
+  (identical in-flight specs coalesce at one replica) with
+  deadline-preserving failover when a replica dies — at-most-once
+  device work via the shared cache, bit-identical bytes via the
+  (seed, spec_hash) key fold.
 """
 
 from .cache import ResultCache
+from .fleet import ReplicaFleet
 from .programs import DEFAULT_WIDTHS, ProgramRegistry, enable_compilation_cache
+from .router import FleetRouter, RouteFailed, make_router_server
 from .service import (RequestFailed, RequestRejected, SERVE_STAGES,
                       SimulationService)
 from .spec import (SpecError, build_geometry, canonicalize, geometry_hash,
@@ -38,6 +50,10 @@ __all__ = [
     "RequestRejected",
     "RequestFailed",
     "ResultCache",
+    "ReplicaFleet",
+    "FleetRouter",
+    "RouteFailed",
+    "make_router_server",
     "ProgramRegistry",
     "DEFAULT_WIDTHS",
     "SERVE_STAGES",

@@ -45,6 +45,9 @@ def main(argv=None):
     ap.add_argument("--warmup", default=None,
                     help="JSON file: one spec (or a list) whose geometries "
                          "are compiled before the ready line")
+    ap.add_argument("--replica-id", type=int, default=None,
+                    help="fleet replica identity (reported in /healthz "
+                         "and the ready line; ReplicaFleet assigns it)")
     ap.add_argument("--verify-cache", action="store_true",
                     help="re-hash every cached artifact against the "
                          "journal on startup (the relaunch-after-crash "
@@ -74,7 +77,8 @@ def main(argv=None):
     service = SimulationService(
         cache_dir=args.cache_dir, widths=widths, max_queue=args.max_queue,
         batch_window_s=args.batch_window_ms / 1e3,
-        verify_cache=args.verify_cache, faults=faults)
+        verify_cache=args.verify_cache, faults=faults,
+        replica_id=args.replica_id)
 
     if args.warmup:
         with open(args.warmup) as f:
@@ -87,6 +91,7 @@ def main(argv=None):
     def _ready(s):
         print(json.dumps({"ready": True, "host": args.host,
                           "port": s.server_port,
+                          "replica_id": args.replica_id,
                           "cache": bool(args.cache_dir)}),
               file=real_stdout, flush=True)
 

@@ -16,18 +16,54 @@ and a SIGKILL'd server must come back with every committed result intact
   truncated, or torn is dropped from the index (and the next request for
   it recomputes) instead of being served corrupt.
 
+**Shared tier (cross-process commit discipline).**  One cache dir is
+shared by every replica of a serving fleet, so commits must be safe
+against *other processes*, not just other threads:
+
+* One writer per artifact: a commit first takes a per-hash
+  ``O_CREAT|O_EXCL`` claim marker (``claims/<hash>.claim``) — atomic on
+  POSIX, the same once-semantics the fault plan uses.  A concurrent
+  duplicate put loses the claim race and simply waits for the winner's
+  journal record: duplicate puts are benign no-ops, never torn files or
+  double journal records.
+* Journal appends happen under an ``flock`` on ``cache.lock`` as ONE
+  ``write`` to an ``O_APPEND`` fd, fsync'd before the lock drops — two
+  replicas can never interleave halves of two records.
+* Commit order is artifact-then-journal: the artifact is durably renamed
+  into place BEFORE its journal line exists, and readers index from the
+  journal only — so a reader can never index an artifact whose bytes are
+  not yet durable.  A writer SIGKILL'd between the two leaves a stale
+  claim and an unindexed file; the next writer for that hash breaks the
+  claim (marker older than ``claim_timeout_s``), atomically re-renames
+  its own bytes over the orphan, and commits normally.
+* Readers refresh their in-memory index from the journal tail on every
+  miss, so a replica serves artifacts committed by its peers without
+  reopening anything.  Compaction (below) is detected by inode change
+  and answered with a full replay.
+
+**Journal compaction (on open).**  verify-drops and superseded records
+accumulate forever in an append-only journal; once the dead-record count
+passes ``compact_min_dead`` the journal is rewritten at open — live
+records only, temp + fsync + atomic rename, under the cross-process lock
+— so long-lived cache dirs stop replaying unbounded history.
+
 The ``serve.kill`` fault point fires here, immediately after a journal
-commit, so tests/serve_runner.py can SIGKILL the serving process at the
-exact boundary the durability contract is written against.
+commit (and deliberately before the claim marker is released, so the
+relaunch path also proves orphan-claim cleanup); ``cache.contend``
+sleeps inside the claim-held / journal-absent window so contention
+stress tests reliably hit the race the discipline exists for.
 """
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import hashlib
 import io
 import json
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -36,42 +72,91 @@ from ..runtime.faults import crash_process, should_fire
 __all__ = ["ResultCache"]
 
 _JOURNAL_NAME = "cache_journal.jsonl"
+_LOCK_NAME = "cache.lock"
+_CLAIMS_DIR = "claims"
 
 
 class ResultCache:
     """Crash-safe content-addressed artifact store for served results.
 
-    Thread-safe: the HTTP threads, the batcher, and ``/metrics`` all call
-    in concurrently; every index/journal mutation is under one lock (file
-    writes of distinct artifacts could proceed in parallel, but serving
-    artifacts are small — simplicity wins).
+    Thread-safe AND process-safe: the HTTP threads, the batcher, and
+    ``/metrics`` of every replica sharing the cache dir all call in
+    concurrently; in-process index/journal mutations are under one
+    thread lock, cross-process commits under the per-hash claim marker
+    plus the journal ``flock`` (module docstring).
+
+    Parameters
+    ----------
+    cache_dir : str
+        Shared cache root (created if missing).
+    verify : bool
+        Re-hash every indexed artifact on open (the relaunch path).
+    faults : FaultPlan, optional
+        Arms ``serve.kill`` / ``cache.contend`` (tests only).
+    claim_timeout_s : float
+        Age after which another writer's claim marker is presumed
+        abandoned (its process died mid-commit) and broken.
+    compact_min_dead : int
+        Dead journal records (drops/supersedes) tolerated before the
+        open path compacts the journal.
     """
 
-    def __init__(self, cache_dir, verify=False, faults=None):
+    def __init__(self, cache_dir, verify=False, faults=None,
+                 claim_timeout_s=5.0, compact_min_dead=64):
         self.cache_dir = str(cache_dir)
         self.results_dir = os.path.join(self.cache_dir, "results")
+        self.claims_dir = os.path.join(self.cache_dir, _CLAIMS_DIR)
         os.makedirs(self.results_dir, exist_ok=True)
+        os.makedirs(self.claims_dir, exist_ok=True)
         self.journal_path = os.path.join(self.cache_dir, _JOURNAL_NAME)
+        self.lock_path = os.path.join(self.cache_dir, _LOCK_NAME)
+        self.claim_timeout_s = float(claim_timeout_s)
+        self.compact_min_dead = int(compact_min_dead)
         self._lock = threading.Lock()
         self._journal_f = None
+        self._lock_f = None
         self._faults = faults
         self._index = {}       # spec hash -> journal record
+        self._journal_pos = 0  # bytes of journal already replayed
+        self._journal_ino = None
         self._puts = 0         # commits by THIS process (serve.kill arm)
         self.hits = 0
         self.misses = 0
         self.verified = 0      # artifacts re-hashed ok on open
         self.dropped = 0       # artifacts dropped by verify
-        self._load_journal()
+        self.compacted = 0     # dead journal records dropped at open
+        self.claim_breaks = 0  # stale claims this process broke
+        with self._lock, self._flocked():
+            self._open_journal_locked()
         if verify:
             self.verify_all()
 
-    # -- open / verify -----------------------------------------------------
+    # -- cross-process lock ------------------------------------------------
 
-    def _load_journal(self):
-        """Replay the journal; truncate a torn tail (mirrors the run
-        supervisor: appending after a newline-less fragment would weld
-        this run's first record onto it, losing BOTH)."""
+    @contextlib.contextmanager
+    def _flocked(self):
+        """Exclusive cross-process lock over journal mutations.  flock
+        is per open-file-description, so even two cache instances inside
+        ONE process exclude each other (which is what lets the stress
+        tests drive the protocol in-process too)."""
+        if self._lock_f is None:
+            self._lock_f = open(self.lock_path, "a")
+        fcntl.flock(self._lock_f.fileno(), fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(self._lock_f.fileno(), fcntl.LOCK_UN)
+
+    # -- open / replay / compaction ---------------------------------------
+
+    def _open_journal_locked(self):
+        """Open-time replay under the cross-process lock: torn-tail
+        truncation (no writer is mid-append while we hold the flock, so
+        a newline-less tail is definitely a crash remnant), then
+        compaction when dead records passed the threshold.  Caller holds
+        the thread lock and the flock."""
         valid_end = 0
+        replayed = 0
         try:
             with open(self.journal_path, "rb") as f:
                 for line in f:
@@ -82,17 +167,113 @@ class ResultCache:
                     except json.JSONDecodeError:
                         break
                     valid_end += len(line)
-                    if rec.get("e") == "put":
-                        self._index[rec["hash"]] = rec
+                    replayed += 1
+                    self._apply_record(rec)
         except FileNotFoundError:
+            self._journal_pos = 0
+            self._journal_ino = None
             return
         if valid_end < os.path.getsize(self.journal_path):
             with open(self.journal_path, "rb+") as f:
                 f.truncate(valid_end)
+        self._journal_pos = valid_end
+        self._journal_ino = os.stat(self.journal_path).st_ino
+        dead = replayed - len(self._index)
+        if dead >= self.compact_min_dead:
+            self._compact_locked(dead)
+
+    def _apply_record(self, rec):
+        e = rec.get("e")
+        if e == "put":
+            self._index[rec["hash"]] = rec
+        elif e == "drop":
+            self._index.pop(rec["hash"], None)
+
+    def _compact_locked(self, dead):
+        """Rewrite the journal with live records only: temp + fsync +
+        atomic rename.  Peers detect the inode change on their next
+        refresh and re-replay from byte 0 — live entries survive
+        compaction by construction, so their rebuilt index is identical.
+        Caller holds the thread lock and the flock."""
+        tmp = self.journal_path + ".tmp"
+        with open(tmp, "w") as f:
+            for h in sorted(self._index):
+                f.write(json.dumps(self._index[h], sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.journal_path)
+        if self._journal_f is not None:
+            self._journal_f.close()
+            self._journal_f = None
+        st = os.stat(self.journal_path)
+        self._journal_pos = st.st_size
+        self._journal_ino = st.st_ino
+        self.compacted += dead
+
+    def _refresh_locked(self):
+        """Fold journal records appended by OTHER processes since the
+        last read into the index.  Complete lines only — without the
+        flock a writer may be mid-append, so an incomplete tail is left
+        for the next refresh, never truncated here.  A shrunken or
+        re-inoded journal means a peer compacted: re-replay from zero
+        (the compacted journal holds every live record).  Caller holds
+        the thread lock."""
+        try:
+            st = os.stat(self.journal_path)
+        except FileNotFoundError:
+            return
+        if st.st_ino != self._journal_ino or st.st_size < self._journal_pos:
+            self._index = {}
+            self._journal_pos = 0
+            self._journal_ino = st.st_ino
+        if st.st_size == self._journal_pos:
+            return
+        with open(self.journal_path, "rb") as f:
+            f.seek(self._journal_pos)
+            buf = f.read()
+        pos = self._journal_pos
+        for line in buf.splitlines(keepends=True):
+            if not line.endswith(b"\n"):
+                break
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                break
+            pos += len(line)
+            self._apply_record(rec)
+        self._journal_pos = pos
+
+    def _append_record_locked(self, rec):
+        """One fsync'd journal append as a single ``write`` on an
+        ``O_APPEND`` fd.  Caller holds the thread lock and the flock;
+        the fd is re-opened when a peer's compaction swapped the inode
+        out from under it (appends to the dead inode would vanish)."""
+        if self._journal_f is not None:
+            try:
+                if (os.fstat(self._journal_f.fileno()).st_ino
+                        != os.stat(self.journal_path).st_ino):
+                    self._journal_f.close()
+                    self._journal_f = None
+            except FileNotFoundError:
+                pass
+        if self._journal_f is None:
+            fd = os.open(self.journal_path,
+                         os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+            self._journal_f = os.fdopen(fd, "w")
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        self._journal_f.write(line)
+        self._journal_f.flush()
+        os.fsync(self._journal_f.fileno())
+        self._journal_pos = os.stat(self.journal_path).st_size
+        self._journal_ino = os.fstat(self._journal_f.fileno()).st_ino
+
+    # -- verify ------------------------------------------------------------
 
     def verify_all(self):
         """Re-hash every indexed artifact against its journal record;
-        drop entries whose file is missing or whose bytes differ.
+        drop entries whose file is missing or whose bytes differ — and
+        journal the drop (under the cross-process lock), so peers and
+        future opens do not resurrect a record whose artifact is gone.
         Returns ``(verified, dropped)`` counts."""
         with self._lock:
             bad = []
@@ -108,12 +289,15 @@ class ResultCache:
                     bad.append(h)
                     continue
                 self.verified += 1
-            for h in bad:
-                del self._index[h]
-                try:
-                    os.unlink(self._artifact_path(h))
-                except OSError:
-                    pass
+            if bad:
+                with self._flocked():
+                    for h in bad:
+                        del self._index[h]
+                        self._append_record_locked({"e": "drop", "hash": h})
+                        try:
+                            os.unlink(self._artifact_path(h))
+                        except OSError:
+                            pass
             self.dropped += len(bad)
             return self.verified, self.dropped
 
@@ -122,8 +306,14 @@ class ResultCache:
     def _artifact_path(self, h):
         return os.path.join(self.results_dir, f"{h}.npy")
 
+    def _claim_path(self, h):
+        return os.path.join(self.claims_dir, f"{h}.claim")
+
     def __contains__(self, h):
         with self._lock:
+            if h in self._index:
+                return True
+            self._refresh_locked()
             return h in self._index
 
     def __len__(self):
@@ -132,10 +322,16 @@ class ResultCache:
 
     def get(self, h):
         """The cached artifact for spec hash ``h`` (a numpy array), or
-        None on miss.  A hit never touches the device — the serving
-        engine's device-call counter is asserted against exactly this."""
+        None on miss.  A miss refreshes the index from the journal tail
+        first, so commits by peer replicas over the shared dir are
+        served without any restart.  A hit never touches the device —
+        the serving engine's device-call counter is asserted against
+        exactly this."""
         with self._lock:
             rec = self._index.get(h)
+            if rec is None:
+                self._refresh_locked()
+                rec = self._index.get(h)
         if rec is None:
             with self._lock:
                 self.misses += 1
@@ -153,11 +349,55 @@ class ResultCache:
             self.hits += 1
         return arr
 
+    def _claim(self, h):
+        """Become THE writer for ``h``, or return the record another
+        writer committed while we waited.  The claim marker is
+        ``O_CREAT|O_EXCL`` — atomic across processes; a marker older
+        than ``claim_timeout_s`` whose journal record never arrived is a
+        dead writer's (killed between artifact rename and journal
+        append) and is broken under the flock."""
+        path = self._claim_path(h)
+        while True:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                pass
+            else:
+                os.write(fd, f"{os.getpid()}\n".encode())
+                os.close(fd)
+                return None
+            # lost the race: wait for the winner's journal record
+            with self._lock:
+                self._refresh_locked()
+                rec = self._index.get(h)
+            if rec is not None:
+                # committed; the marker may be an orphan from a writer
+                # killed after its journal append — clean it up
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                return rec
+            try:
+                age = time.time() - os.stat(path).st_mtime
+            except FileNotFoundError:
+                continue  # winner finished or died; retry the claim
+            if age > self.claim_timeout_s:
+                with self._lock, self._flocked():
+                    self._refresh_locked()
+                    rec = self._index.get(h)
+                    if rec is not None:
+                        return rec
+                    with contextlib.suppress(OSError):
+                        os.unlink(path)
+                    self.claim_breaks += 1
+                continue
+            time.sleep(0.005)
+
     def put(self, h, array, meta=None):
-        """Commit one artifact: atomic file write, then the fsync'd
-        journal line that makes it durable.  Idempotent per hash (a
-        concurrent duplicate put is a no-op).  Returns the journal
-        record."""
+        """Commit one artifact: claim the hash, atomic file write, then
+        the flock-guarded fsync'd journal line that makes it durable.
+        Idempotent per hash across threads AND processes (a concurrent
+        duplicate put waits out the winner and returns its record).
+        Returns the journal record."""
         array = np.ascontiguousarray(array)
         buf = io.BytesIO()
         np.save(buf, array)
@@ -171,28 +411,52 @@ class ResultCache:
         with self._lock:
             if h in self._index:
                 return self._index[h]
+            self._refresh_locked()
+            if h in self._index:
+                return self._index[h]
+        won = self._claim(h)
+        if won is not None:      # a peer committed while we waited
+            with self._lock:
+                self._index.setdefault(h, won)
+            return won
+        try:
+            # artifact first (temp + fsync + atomic rename), journal
+            # second: an artifact is durable before it is indexable
             path = self._artifact_path(h)
-            tmp = path + ".tmp"
+            tmp = f"{path}.{os.getpid()}.tmp"
             with open(tmp, "wb") as f:
                 f.write(payload)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
-            if self._journal_f is None:
-                self._journal_f = open(self.journal_path, "a")
-            self._journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
-            self._journal_f.flush()
-            os.fsync(self._journal_f.fileno())
-            self._index[h] = rec
-            self._puts += 1
-            puts = self._puts
-        # serve.kill: die AFTER the durable commit — the relaunch must
-        # find exactly `after_puts` artifacts, verified and servable
-        if self._faults is not None:
-            cfg = self._faults.config("serve.kill")
-            if cfg is not None and puts >= int(cfg.get("after_puts", 1)):
-                if should_fire(self._faults, "serve.kill", token=h):
-                    crash_process()
+            # cache.contend: dwell inside the claim-held/journal-absent
+            # window so multi-process stress reliably overlaps commits
+            if self._faults is not None:
+                cfg = self._faults.config("cache.contend")
+                if cfg is not None and should_fire(
+                        self._faults, "cache.contend", token=h):
+                    time.sleep(float(cfg.get("hold_s", 0.05)))
+            with self._lock:
+                with self._flocked():
+                    self._refresh_locked()
+                    if h not in self._index:
+                        self._append_record_locked(rec)
+                        self._index[h] = rec
+                        self._puts += 1
+                rec = self._index[h]
+                puts = self._puts
+            # serve.kill: die AFTER the durable commit but BEFORE the
+            # claim release — the relaunch must find exactly
+            # `after_puts` artifacts, verified and servable, and peers
+            # must treat the orphan marker as the no-op it is
+            if self._faults is not None:
+                cfg = self._faults.config("serve.kill")
+                if cfg is not None and puts >= int(cfg.get("after_puts", 1)):
+                    if should_fire(self._faults, "serve.kill", token=h):
+                        crash_process()
+        finally:
+            with contextlib.suppress(OSError):
+                os.unlink(self._claim_path(h))
         return rec
 
     def stats(self):
@@ -200,10 +464,15 @@ class ResultCache:
         with self._lock:
             return {"entries": len(self._index), "hits": self.hits,
                     "misses": self.misses, "verified": self.verified,
-                    "dropped": self.dropped, "puts": self._puts}
+                    "dropped": self.dropped, "puts": self._puts,
+                    "compacted": self.compacted,
+                    "claim_breaks": self.claim_breaks}
 
     def close(self):
         with self._lock:
             if self._journal_f is not None:
                 self._journal_f.close()
                 self._journal_f = None
+            if self._lock_f is not None:
+                self._lock_f.close()
+                self._lock_f = None

@@ -1,0 +1,317 @@
+"""Supervised replica fleet: N serving processes over one shared cache.
+
+One HTTP process per chip was the serving ceiling (ROADMAP item 1); this
+module is the horizontal half of lifting it.  A :class:`ReplicaFleet`
+
+* spawns N ``python -m psrsigsim_tpu.serve`` subprocesses over ONE
+  cache dir — safe because :class:`~psrsigsim_tpu.serve.ResultCache`
+  commits with cross-process single-writer discipline (claim markers +
+  flock-guarded journal appends), so replicas share committed results
+  and device work is at-most-once per spec fleet-wide;
+* supervises each replica with a
+  :class:`~psrsigsim_tpu.runtime.ProcessSupervisor`: a dead replica is
+  restarted under a jittered
+  :class:`~psrsigsim_tpu.runtime.RetryPolicy` (no respawn lockstep, no
+  unbounded flapping), re-binds its port, and re-enters routing at a new
+  endpoint *generation*;
+* health-checks every replica via the grown ``/healthz`` (replica id,
+  uptime, device calls, per-program compile counts) and SIGKILLs one
+  that stops answering, handing it back to the supervisor;
+* degrades gracefully below quorum: the router stops admitting (the
+  explicit-backpressure path, not a hang) until enough replicas return;
+* propagates drain fleet-wide: :meth:`drain` sends every replica the
+  SIGTERM graceful-drain signal the single-server path already honors,
+  and :meth:`install_sigterm_drain` wires the fleet process's own
+  SIGTERM to it.
+
+Restart warmup is bounded by construction: replicas share the
+persistent compilation cache under the cache dir, so a respawned
+replica warms from disk instead of recompiling (PR-5's registry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from ..runtime.retry import RetryPolicy
+from ..runtime.supervisor import ProcessSupervisor
+
+__all__ = ["ReplicaFleet"]
+
+
+class ReplicaFleet:
+    """Spawn, route-track, health-check, and restart N serving replicas.
+
+    Parameters
+    ----------
+    n_replicas : int
+        Fleet size.  Each replica is ``python -m psrsigsim_tpu.serve
+        --port 0`` with a unique ``--replica-id``.
+    cache_dir : str
+        THE shared content-addressed result cache root (plus the shared
+        persistent compilation cache under it).
+    widths : tuple of int
+        Bucket widths forwarded to every replica.
+    warmup_path : str, optional
+        Warmup-spec JSON forwarded to every replica (``--warmup``), so
+        each comes up with its programs compiled before taking traffic.
+    verify_cache : bool
+        Relaunch replicas with ``--verify-cache`` (the shared dir may
+        hold a crashed peer's artifacts — verify, don't trust).
+    fault_plan_path : str, optional
+        FaultPlan JSON forwarded to every replica (tests only).
+    policy : RetryPolicy, optional
+        Per-replica restart budget (default: 5 attempts, jittered).
+    quorum : int, optional
+        Healthy-replica floor below which the fleet reports degraded
+        (default: strict majority).
+    health_interval_s / health_fail_after :
+        ``/healthz`` poll period and the consecutive-failure count after
+        which an unresponsive replica is SIGKILLed for restart.
+    ready_timeout_s : float
+        How long one replica may take to print its ready line (covers a
+        cold JAX import + warmup compile).
+    log_dir : str, optional
+        Per-replica stderr logs (``replica<i>.log``); default discards.
+    """
+
+    def __init__(self, n_replicas, cache_dir, *, widths=(1, 8),
+                 max_queue=64, batch_window_ms=2.0, warmup_path=None,
+                 verify_cache=True, fault_plan_path=None, policy=None,
+                 quorum=None, health_interval_s=0.5, health_fail_after=3,
+                 ready_timeout_s=180.0, log_dir=None, env=None,
+                 host="127.0.0.1"):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.n_replicas = int(n_replicas)
+        self.cache_dir = str(cache_dir)
+        self.host = host
+        self.widths = tuple(int(w) for w in widths)
+        self.max_queue = int(max_queue)
+        self.batch_window_ms = float(batch_window_ms)
+        self.warmup_path = warmup_path
+        self.verify_cache = bool(verify_cache)
+        self.fault_plan_path = fault_plan_path
+        self.quorum = (int(quorum) if quorum is not None
+                       else self.n_replicas // 2 + 1)
+        self.health_interval_s = float(health_interval_s)
+        self.health_fail_after = int(health_fail_after)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.log_dir = log_dir
+        self._env = dict(env) if env is not None else None
+        policy = policy if policy is not None else RetryPolicy(
+            max_attempts=5, base_delay=0.05, max_delay=2.0, jitter=0.5)
+        self._lock = threading.Lock()
+        # replica id -> {"url": str|None, "gen": int, "health": dict|None,
+        #               "health_fails": int}
+        self._endpoints = {
+            i: {"url": None, "gen": 0, "health": None, "health_fails": 0}
+            for i in range(self.n_replicas)}
+        self._stopping = False
+        self._health_thread = None
+        self._sups = {
+            i: ProcessSupervisor(
+                f"replica{i}",
+                spawn=(lambda i=i: self._spawn_replica(i)),
+                policy=policy,
+                on_exit=(lambda sup, rc, i=i: self._mark_down(i)))
+            for i in range(self.n_replicas)}
+
+    # -- spawning ----------------------------------------------------------
+
+    def _replica_cmd(self, i):
+        cmd = [sys.executable, "-m", "psrsigsim_tpu.serve",
+               "--host", self.host, "--port", "0",
+               "--cache-dir", self.cache_dir,
+               "--replica-id", str(i),
+               "--widths", ",".join(str(w) for w in self.widths),
+               "--max-queue", str(self.max_queue),
+               "--batch-window-ms", str(self.batch_window_ms)]
+        if self.warmup_path:
+            cmd += ["--warmup", str(self.warmup_path)]
+        if self.verify_cache:
+            cmd += ["--verify-cache"]
+        if self.fault_plan_path:
+            cmd += ["--fault-plan", str(self.fault_plan_path)]
+        return cmd
+
+    def _spawn_replica(self, i):
+        """Launch replica ``i`` and wait for its one-line ready protocol
+        (which carries the kernel-assigned port).  On a failed/withheld
+        ready line the process is killed and returned anyway — the
+        supervisor's watcher sees the death and retries under the
+        backoff policy, so a replica that crashes during startup cannot
+        wedge the fleet."""
+        stderr = subprocess.DEVNULL
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stderr = open(os.path.join(self.log_dir, f"replica{i}.log"),
+                          "ab")
+        proc = subprocess.Popen(
+            self._replica_cmd(i), stdout=subprocess.PIPE, stderr=stderr,
+            text=True, env=self._env)
+        if stderr is not subprocess.DEVNULL:
+            stderr.close()
+        ready = {}
+        line = [None]
+
+        def _read():
+            line[0] = proc.stdout.readline()
+
+        t = threading.Thread(target=_read, daemon=True)
+        t.start()
+        t.join(self.ready_timeout_s)
+        if line[0]:
+            try:
+                ready = json.loads(line[0])
+            except json.JSONDecodeError:
+                ready = {}
+        if not ready.get("ready"):
+            # startup failure: hand the corpse to the supervisor
+            if proc.poll() is None:
+                proc.kill()
+            self._mark_down(i)
+            return proc
+        with self._lock:
+            ep = self._endpoints[i]
+            ep["url"] = f"http://{self.host}:{ready['port']}"
+            ep["gen"] += 1
+            ep["health_fails"] = 0
+        return proc
+
+    def _mark_down(self, i):
+        with self._lock:
+            self._endpoints[i]["url"] = None
+            self._endpoints[i]["health"] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Spawn every replica (serially — each binds port 0, no
+        contention) and the health-check loop.  Returns self."""
+        for sup in self._sups.values():
+            sup.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="pss-fleet-health")
+        self._health_thread.start()
+        return self
+
+    def drain(self, timeout=60.0):
+        """Fleet-wide graceful drain: SIGTERM to every replica (each
+        finishes in-flight work, closes its cache journal, exits 0),
+        supervisors stopped, health loop joined.  Returns {replica id:
+        exit code}."""
+        with self._lock:
+            self._stopping = True
+        codes = {}
+        for i, sup in self._sups.items():
+            codes[i] = sup.stop(signal.SIGTERM, timeout=timeout)
+        if self._health_thread is not None:
+            self._health_thread.join(timeout)
+        return codes
+
+    def install_sigterm_drain(self, exit_after=True):
+        """Propagate SIGTERM (and SIGINT) on THIS process fleet-wide:
+        the signal that drains one server drains the whole fleet.  With
+        ``exit_after`` (the default) the process then terminates via
+        the restored default handler — the single-server contract; a
+        fleet that drained but kept answering 503s forever would just
+        earn the orchestrator's SIGKILL.  Pass ``exit_after=False``
+        when the caller owns process teardown (e.g. it still has an
+        HTTP listener to close)."""
+        def _drain(signum, frame):
+            def _run():
+                self.drain()
+                if exit_after:
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+            threading.Thread(target=_run, daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
+
+    def kill_replica(self, i, sig=signal.SIGKILL):
+        """Chaos/ops entry: signal one replica (default SIGKILL — the
+        ``replica.kill`` fault uses this).  The supervisor restarts it
+        under the backoff policy; routing drops it immediately."""
+        self._mark_down(i)
+        self._sups[i].kill(sig)
+
+    # -- routing / health views -------------------------------------------
+
+    def endpoints(self):
+        """Live ``(replica_id, base_url)`` pairs, routing's view."""
+        with self._lock:
+            eps = [(i, ep["url"]) for i, ep in self._endpoints.items()
+                   if ep["url"] is not None]
+        return [(i, u) for i, u in eps if self._sups[i].alive()]
+
+    def endpoint_gen(self, i):
+        with self._lock:
+            return self._endpoints[i]["gen"]
+
+    def healthy_count(self):
+        return len(self.endpoints())
+
+    def has_quorum(self):
+        return self.healthy_count() >= self.quorum
+
+    def degraded(self):
+        return not self.has_quorum()
+
+    def health(self):
+        """Fleet-level health summary (the router's ``/healthz``)."""
+        with self._lock:
+            per = {i: dict(ep["health"]) if ep["health"] else None
+                   for i, ep in self._endpoints.items()}
+        return {
+            "ok": self.has_quorum(),
+            "replicas": self.n_replicas,
+            "healthy": self.healthy_count(),
+            "quorum": self.quorum,
+            "degraded": self.degraded(),
+            "restarts": {i: s.restarts for i, s in self._sups.items()},
+            "failed": [i for i, s in self._sups.items() if s.failed],
+            "health": per,
+        }
+
+    def _health_loop(self):
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+            for i, url in self.endpoints():
+                try:
+                    with urllib.request.urlopen(
+                            url + "/healthz", timeout=2.0) as r:
+                        h = json.loads(r.read())
+                except (urllib.error.URLError, OSError,
+                        json.JSONDecodeError):
+                    with self._lock:
+                        ep = self._endpoints[i]
+                        ep["health_fails"] += 1
+                        fails = ep["health_fails"]
+                    if fails >= self.health_fail_after:
+                        # unresponsive but not exited (wedged listener,
+                        # livelock): SIGKILL it into the supervisor's
+                        # restart path instead of routing into a tarpit
+                        self.kill_replica(i, signal.SIGKILL)
+                    continue
+                with self._lock:
+                    ep = self._endpoints[i]
+                    ep["health"] = h
+                    ep["health_fails"] = 0
+            time.sleep(self.health_interval_s)
+
+    def __repr__(self):
+        return (f"ReplicaFleet(n={self.n_replicas}, "
+                f"healthy={self.healthy_count()}, quorum={self.quorum})")

@@ -16,7 +16,11 @@ endpoint               behavior
 ``GET /result/<id>``   200 ``{"id", "shape", "dtype", "profile": [[...]]}``
                        when done; 409 while queued/running; 410 for
                        expired/errored; 404 unknown.
-``GET /healthz``       200 ``{"ok": true, "queue_depth", "draining"}``.
+``GET /healthz``       200 ``{"ok": true, "replica_id", "uptime_s",
+                       "queue_depth", "draining", "served",
+                       "device_calls", "programs", "compile_counts"}``
+                       — the fleet supervisor's health-check and
+                       per-replica single-compile guard read this.
 ``GET /metrics``       200: the service metrics dict — stage seconds +
                        latency p50/p95/p99, queue depths, per-bucket
                        program hit counts, cache stats, per-scenario
@@ -110,10 +114,7 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.rstrip("/")
         if path == "/healthz":
-            m = self.service.metrics()
-            return self._reply(200, {"ok": True,
-                                     "queue_depth": m["queue_depth"],
-                                     "draining": m["draining"]})
+            return self._reply(200, self.service.health())
         if path == "/metrics":
             return self._reply(200, self.service.metrics())
         if path.startswith("/status/"):

@@ -1,0 +1,356 @@
+"""Consistent-hash front end over a replica fleet, with failover.
+
+The routing layer between "a stream of request specs" and "N serving
+replicas over one shared cache":
+
+* **Consistent routing by spec hash** — rendezvous (highest-random-
+  weight) hashing of ``spec_hash`` over the LIVE replica set, keyed by
+  replica *id* (not port), so identical in-flight specs land on — and
+  coalesce at — exactly one replica, a restarted replica re-enters at
+  its old key range, and a death moves only the dead replica's keys.
+* **Deadline-preserving failover** — a request in flight when its
+  replica dies (connection refused/reset/timeout) is re-routed to the
+  next-best live replica with the *remaining* deadline budget, not a
+  fresh one.  Re-execution is safe: at-most-once device work is
+  guaranteed by the shared cache (a result the dead replica committed
+  is served as a hit by the replacement), and bytes are identical by
+  the (seed, spec_hash) key fold whatever replica computes them.
+* **Graceful degradation** — below fleet quorum the router REJECTS with
+  the explicit-backpressure exception the single-server admission path
+  already uses (:class:`~psrsigsim_tpu.serve.RequestRejected` with a
+  retry-after), never hangs or half-serves.
+
+Chaos points (armed only via an explicit FaultPlan): ``replica.kill``
+SIGKILLs the routed replica right *before* the configured request is
+forwarded — the hardest-case mid-traffic death, proving the re-route +
+restart path deterministically; ``route.blackhole`` makes a routed
+replica unreachable without killing it (the network-partition case).
+
+``make_router_server`` wraps the router in the same stdlib HTTP JSON
+API one replica speaks, so a fleet is a drop-in replacement for a
+single server at one address.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..runtime.faults import should_fire
+from .service import RequestRejected
+from .spec import canonicalize, spec_hash
+
+__all__ = ["FleetRouter", "RouteFailed", "make_router_server"]
+
+
+class RouteFailed(RuntimeError):
+    """Every candidate replica failed (or the deadline expired) for one
+    request; ``attempts`` records the per-replica failures."""
+
+    def __init__(self, msg, attempts):
+        self.attempts = list(attempts)
+        super().__init__(f"{msg}; attempts: {attempts}")
+
+
+def _http_transport(method, url, body, timeout):
+    """Default transport: one HTTP exchange -> ``(status, json dict)``.
+    Transport-level failures (refused, reset, timed out) propagate as
+    OSError/URLError — the router's failover trigger.  Injectable so
+    router logic is testable without sockets."""
+    headers = {"Content-Type": "application/json"} if body else {}
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except (ValueError, OSError):
+            payload = {"error": str(e)}
+        return e.code, payload
+
+
+class FleetRouter:
+    """Route requests across a :class:`~psrsigsim_tpu.serve.ReplicaFleet`.
+
+    ``fleet`` may be any object exposing ``endpoints() ->
+    [(replica_id, base_url)]``, ``has_quorum()``, and
+    ``kill_replica(id, sig)`` — the real fleet, or a stub in tests.
+
+    Thread-safe: traffic threads share one router; counters are under a
+    lock, routing reads a snapshot of the live endpoint list.
+    """
+
+    def __init__(self, fleet, faults=None, default_timeout_s=120.0,
+                 retry_after_s=0.5, transport=None):
+        self.fleet = fleet
+        self._faults = faults
+        self.default_timeout_s = float(default_timeout_s)
+        self.retry_after_s = float(retry_after_s)
+        self._transport = transport if transport is not None else _http_transport
+        self._lock = threading.Lock()
+        self.routed = 0          # responses successfully returned
+        self.forwarded = 0       # forward attempts (includes failovers)
+        self.failovers = 0       # re-routes after a transport failure
+        self.blackholed = 0      # route.blackhole shots absorbed
+        self.kills_fired = 0     # replica.kill shots dispatched
+        self.rejected = 0        # quorum / backpressure rejections
+        self.per_replica = {}    # replica id -> responses served
+
+    # -- consistent routing ------------------------------------------------
+
+    @staticmethod
+    def _score(h, replica_id):
+        return hashlib.sha256(f"{h}:{replica_id}".encode()).digest()
+
+    def route(self, h, exclude=()):
+        """The live replica that owns spec hash ``h``: rendezvous
+        hashing over ``fleet.endpoints()`` minus ``exclude``.  Returns
+        ``(replica_id, base_url)`` or None when nothing is routable."""
+        best = None
+        for rid, url in self.fleet.endpoints():
+            if rid in exclude:
+                continue
+            s = self._score(h, rid)
+            if best is None or s > best[0]:
+                best = (s, rid, url)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    # -- request path ------------------------------------------------------
+
+    def _maybe_chaos_kill(self, rid):
+        """``replica.kill``: SIGKILL the routed replica right before the
+        ``after_requests``-th response would be produced — the forward
+        that follows runs into the freshly dead socket, exercising the
+        worst-case failover ordering deterministically."""
+        if self._faults is None:
+            return
+        cfg = self._faults.config("replica.kill")
+        if cfg is None:
+            return
+        with self._lock:
+            upcoming = self.routed + 1
+        if upcoming < int(cfg.get("after_requests", 1)):
+            return
+        target = cfg.get("replica", rid)
+        if should_fire(self._faults, "replica.kill", token=str(target)):
+            self.fleet.kill_replica(int(target), signal.SIGKILL)
+            with self._lock:
+                self.kills_fired += 1
+
+    def submit(self, spec, deadline_s=None, wait=True, wait_s=None):
+        """Route one spec to its replica and return ``(status, body)``
+        from the replica's ``/simulate``.
+
+        ``deadline_s`` bounds the WHOLE request including failovers: a
+        re-route carries the remaining budget, not a fresh one.  With
+        ``wait`` the call blocks for the result (the chaos harness's
+        mode); ``wait_s`` caps that block at the CLIENT'S requested
+        duration (a short sync wait stays short — the replica answers
+        202/409 after it and the caller polls); without either the
+        replica answers 202 immediately.  Raises
+        :class:`RequestRejected` below quorum and :class:`RouteFailed`
+        when every candidate failed."""
+        canonical = canonicalize(spec)
+        h = spec_hash(canonical)
+        budget = deadline_s if deadline_s is not None else self.default_timeout_s
+        t_end = time.monotonic() + float(budget)
+        excluded = set()
+        attempts = []
+        while True:
+            if not self.fleet.has_quorum():
+                with self._lock:
+                    self.rejected += 1
+                raise RequestRejected("fleet below quorum",
+                                      self.retry_after_s)
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                raise RouteFailed(f"deadline exhausted for {h[:12]}",
+                                  attempts)
+            picked = self.route(h, exclude=excluded)
+            if picked is None:
+                if not excluded:
+                    raise RouteFailed(f"no live replica for {h[:12]}",
+                                      attempts)
+                # every live replica failed once: clear the exclusion,
+                # give restarts a beat to land, and try again
+                excluded.clear()
+                time.sleep(min(0.05, max(remaining, 0.0)))
+                continue
+            rid, url = picked
+            self._maybe_chaos_kill(rid)
+            body = dict(spec)
+            body["deadline_s"] = remaining
+            if wait_s is not None:
+                body["wait"] = min(float(wait_s), remaining)
+            elif wait:
+                body["wait"] = remaining
+            payload = json.dumps(body).encode()
+            try:
+                if should_fire(self._faults, "route.blackhole",
+                               token=str(rid)):
+                    with self._lock:
+                        self.blackholed += 1
+                    raise ConnectionError(
+                        f"route.blackhole: replica {rid} unreachable")
+                with self._lock:
+                    self.forwarded += 1
+                status, resp = self._transport(
+                    "POST", url + "/simulate", payload,
+                    max(remaining, 0.001))
+            except (urllib.error.URLError, ConnectionError, TimeoutError,
+                    OSError) as err:
+                # the replica died (or the route is black-holed) with
+                # this request in flight: exclude it and re-route with
+                # the REMAINING deadline.  Safe to re-execute — a result
+                # the dead replica already committed comes back as a
+                # shared-cache hit on the replacement, never a second
+                # device execution.
+                attempts.append((rid, f"{type(err).__name__}: {err}"))
+                excluded.add(rid)
+                with self._lock:
+                    self.failovers += 1
+                continue
+            with self._lock:
+                self.routed += 1
+                self.per_replica[rid] = self.per_replica.get(rid, 0) + 1
+            return status, resp
+
+    def get(self, path, deadline_s=30.0, key=None):
+        """Route a GET (``/status/<id>``, ``/result/<id>``) by its
+        request id — the same consistent route its POST took, so the
+        replica that holds the request's status answers; after a
+        failover the shared cache backstops ``/result`` on any replica.
+        ``key`` overrides the routing key (defaults to the trailing
+        path segment)."""
+        h = key if key is not None else path.rsplit("/", 1)[-1]
+        t_end = time.monotonic() + float(deadline_s)
+        excluded = set()
+        attempts = []
+        while True:
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                raise RouteFailed(f"deadline exhausted for GET {path}",
+                                  attempts)
+            picked = self.route(h, exclude=excluded)
+            if picked is None:
+                raise RouteFailed(f"no live replica for GET {path}",
+                                  attempts)
+            rid, url = picked
+            try:
+                return self._transport("GET", url + path, None,
+                                       max(remaining, 0.001))
+            except (urllib.error.URLError, ConnectionError, TimeoutError,
+                    OSError) as err:
+                attempts.append((rid, f"{type(err).__name__}: {err}"))
+                excluded.add(rid)
+                with self._lock:
+                    self.failovers += 1
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {
+                "routed": self.routed,
+                "forwarded": self.forwarded,
+                "failovers": self.failovers,
+                "blackholed": self.blackholed,
+                "kills_fired": self.kills_fired,
+                "rejected": self.rejected,
+                "per_replica": dict(self.per_replica),
+            }
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "psrsigsim-fleet-router/1.0"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def router(self):
+        return self.server.router
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, code, obj, headers=()):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_POST(self):
+        if self.path.rstrip("/") != "/simulate":
+            return self._reply(404, {"error": f"no such endpoint {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as err:
+            return self._reply(400, {"error": f"bad JSON body: {err}"})
+        if not isinstance(body, dict):
+            return self._reply(400, {"error": "spec body must be a JSON object"})
+        try:
+            wait_s = body.pop("wait", None)
+            wait_s = None if wait_s is None else float(wait_s)
+            deadline_s = body.pop("deadline_s", None)
+            deadline_s = None if deadline_s is None else float(deadline_s)
+        except (TypeError, ValueError):
+            # the single server's contract exactly (http.py): a clean
+            # 400, not a dropped connection from the handler thread
+            return self._reply(
+                400, {"error": "wait / deadline_s must be numbers"})
+        try:
+            from .spec import SpecError
+
+            status, resp = self.router.submit(
+                body, deadline_s=deadline_s, wait=wait_s is not None,
+                wait_s=wait_s)
+        except SpecError as err:
+            return self._reply(400, {"error": "invalid spec",
+                                     "fields": err.errors})
+        except RequestRejected as err:
+            return self._reply(
+                503, {"error": err.reason,
+                      "retry_after_s": err.retry_after_s},
+                headers=[("Retry-After", f"{err.retry_after_s:.3f}")])
+        except RouteFailed as err:
+            return self._reply(504, {"error": str(err)})
+        return self._reply(status, resp)
+
+    def do_GET(self):
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            return self._reply(200, self.router.fleet.health())
+        if path == "/metrics":
+            return self._reply(200, {"router": self.router.stats(),
+                                     "fleet": self.router.fleet.health()})
+        if path.startswith(("/status/", "/result/")):
+            try:
+                status, resp = self.router.get(path)
+            except (RouteFailed, RequestRejected) as err:
+                return self._reply(504, {"error": str(err)})
+            return self._reply(status, resp)
+        return self._reply(404, {"error": f"no such endpoint {self.path}"})
+
+
+def make_router_server(router, host="127.0.0.1", port=0):
+    """A ``ThreadingHTTPServer`` speaking the single-server JSON API,
+    backed by the fleet: one address in front of N replicas.  ``port=0``
+    picks a free port (``server.server_port``)."""
+    srv = ThreadingHTTPServer((host, port), _RouterHandler)
+    srv.daemon_threads = True
+    srv.router = router
+    return srv

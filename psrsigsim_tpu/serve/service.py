@@ -131,11 +131,13 @@ class SimulationService:
     def __init__(self, cache_dir=None, widths=DEFAULT_WIDTHS, max_queue=64,
                  batch_window_s=0.002, retry_after_s=0.5, telemetry=None,
                  faults=None, verify_cache=False, compile_cache_dir=None,
-                 max_done=1024):
+                 max_done=1024, replica_id=None):
         import os
 
         if compile_cache_dir is None and cache_dir is not None:
             compile_cache_dir = os.path.join(str(cache_dir), "compile_cache")
+        self.replica_id = replica_id
+        self.started_at = time.time()
         self.registry = ProgramRegistry(widths,
                                         compile_cache_dir=compile_cache_dir)
         self.cache = (ResultCache(cache_dir, verify=verify_cache,
@@ -315,6 +317,29 @@ class SimulationService:
             self.cache.close()
         return ok
 
+    def health(self):
+        """The ``/healthz`` payload, grown for fleet supervision: the
+        liveness bit plus the identity and progress counters a fleet
+        health-checker routes and restarts on — replica id, uptime,
+        device calls, and per-(geometry, width) compile counts (the
+        per-replica single-compile guard reads these over HTTP)."""
+        with self._cond:
+            depth = len(self._queue)
+            draining = self._draining
+            served = self.served
+        reg = self.registry.stats()
+        return {
+            "ok": True,
+            "replica_id": self.replica_id,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "queue_depth": depth,
+            "draining": draining,
+            "served": served,
+            "device_calls": reg["device_calls"],
+            "programs": reg["programs"],
+            "compile_counts": reg["compile_counts"],
+        }
+
     def metrics(self):
         """One JSON-ready dict: stage timers (with latency percentiles),
         queue depth, admission counters, per-bucket program hit counts,
@@ -322,6 +347,8 @@ class SimulationService:
         with self._cond:
             depth = len(self._queue)
             out = {
+                "replica_id": self.replica_id,
+                "uptime_s": round(time.time() - self.started_at, 3),
                 "queue_depth": depth,
                 "max_queue": self.max_queue,
                 "draining": self._draining,
@@ -396,6 +423,34 @@ class SimulationService:
 
     def _execute(self, batch):
         import jax.numpy as jnp
+
+        # shared-tier re-check: a peer replica over the same cache dir
+        # (or a failover re-route of this very spec) may have committed
+        # a batch member's artifact since submit time — serve those rows
+        # from the cache and keep device work at-most-once per spec
+        # fleet-wide.  get() refreshes from the journal tail on miss, so
+        # no restart is needed to see peer commits.
+        if self.cache is not None:
+            alive = []
+            for r in batch:
+                arr = self.cache.get(r.id)
+                if arr is None:
+                    alive.append(r)
+                    continue
+                r.result = arr
+                r.cached = True
+                r.status = "done"
+                r.done.set()
+                self.timers.add("request",
+                                time.perf_counter() - r.t_submit)
+                with self._cond:
+                    self.cache_hits += 1
+                    self.served += 1
+            batch = alive
+            if not batch:
+                with self._cond:
+                    self._evict_terminal()
+                return
 
         gh = batch[0].geom_hash
         t0 = time.perf_counter()

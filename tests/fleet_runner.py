@@ -1,0 +1,385 @@
+"""Chaos/stress driver for the replicated serving fleet (tests/test_fleet.py,
+``bench.py --fleet-smoke``).
+
+Two subprocess proofs, each printing ONE machine-parseable JSON verdict
+line on stdout:
+
+``--mode chaos``
+    The acceptance pin for the fleet.  (1) a SOLO single-replica run
+    serves M deterministic specs and records every response's profile
+    bytes; (2) a FLEET of N replicas over a fresh shared cache serves
+    the SAME specs from concurrent client threads while ``replica.kill``
+    SIGKILLs the routed replica mid-traffic (the router fails over with
+    the remaining deadline; the supervisor restarts the corpse).  The
+    verdict asserts: every accepted request completed with bytes
+    IDENTICAL to the solo run, zero committed cache artifacts were lost
+    or torn (``verify`` re-hash after drain), every surviving replica
+    compiled each (geometry, width) program at most once, and the kill
+    actually fired (failovers > 0, restarts > 0).  Also reports solo vs
+    fleet throughput (the ``config9_fleet`` bench numbers).
+
+``--mode cache-stress``
+    N worker subprocesses (``--mode stress-worker``) hammer ONE cache
+    dir with overlapping ``put``/``get`` of identical and distinct
+    hashes — ``cache.contend`` dwells inside the claim-held/journal-
+    absent window to force real overlap.  The verdict asserts: the
+    replayed index is consistent, every artifact re-hashes clean,
+    exactly one committed artifact exists per hash with the expected
+    bytes, and no claim markers or temp files leak.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+# mirror tests/conftest.py BEFORE jax initializes (replica subprocesses
+# inherit this environment): unit-test platform is an 8-device virtual
+# CPU so compiled shapes match the pytest process
+os.environ["JAX_PLATFORMS"] = os.environ.get("PSS_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: the fixed fleet geometry (same cheap physics as serve_runner's)
+BASE_SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05,
+    "seed": 3, "dm": 10.0,
+}
+
+
+def request_spec(i):
+    """The i-th deterministic test request (distinct content hashes)."""
+    return dict(BASE_SPEC, seed=300 + i, dm=10.0 + 0.25 * i)
+
+
+def _profile_sha(resp):
+    """Byte-identity fingerprint of one response's served profile."""
+    return hashlib.sha256(
+        json.dumps(resp["profile"]).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# chaos proof
+# ---------------------------------------------------------------------------
+
+
+def _drive(router, specs, threads, deadline_s):
+    """Serve every spec through the router from ``threads`` concurrent
+    clients; returns ({index: profile sha}, elapsed seconds, errors)."""
+    out, errors = {}, []
+
+    def one(i):
+        status, resp = router.submit(specs[i], deadline_s=deadline_s,
+                                     wait=True)
+        if status != 200 or resp.get("status") != "done":
+            raise RuntimeError(f"request {i}: HTTP {status} {resp}")
+        return i, _profile_sha(resp)
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for fut in [pool.submit(one, i) for i in range(len(specs))]:
+            try:
+                i, sha = fut.result()
+                out[i] = sha
+            except Exception as err:  # noqa: BLE001 - collected verdict
+                errors.append(f"{type(err).__name__}: {err}")
+    return out, time.perf_counter() - t0, errors
+
+
+def run_chaos(args):
+    from psrsigsim_tpu.runtime import FaultPlan
+    from psrsigsim_tpu.serve import FleetRouter, ReplicaFleet, ResultCache
+
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    warm_path = os.path.join(out_dir, "warm.json")
+    with open(warm_path, "w") as f:
+        json.dump(BASE_SPEC, f)
+    specs = [request_spec(i) for i in range(args.requests)]
+    widths = tuple(int(w) for w in args.widths.split(","))
+
+    # -- solo baseline: one replica, no faults ---------------------------
+    solo_cache = os.path.join(out_dir, "solo_cache")
+    fleet = ReplicaFleet(1, solo_cache, widths=widths,
+                         warmup_path=warm_path, quorum=1,
+                         log_dir=os.path.join(out_dir, "logs_solo"))
+    fleet.start()
+    try:
+        router = FleetRouter(fleet)
+        solo, solo_s, solo_errs = _drive(router, specs, threads=1,
+                                         deadline_s=args.deadline)
+    finally:
+        fleet.drain()
+    if solo_errs or len(solo) != len(specs):
+        return {"ok": False, "stage": "solo", "errors": solo_errs}
+
+    # -- fleet run: N replicas, one shared cache, kill mid-traffic -------
+    fleet_cache = os.path.join(out_dir, "fleet_cache")
+    plan_spec = {}
+    if not args.no_faults:
+        plan_spec["replica.kill"] = {"after_requests": args.kill_after}
+        if args.blackhole:
+            plan_spec["route.blackhole"] = {"times": 1}
+    plan = FaultPlan(os.path.join(out_dir, "scratch"), plan_spec)
+    fleet = ReplicaFleet(args.replicas, fleet_cache, widths=widths,
+                         warmup_path=warm_path, quorum=1,
+                         log_dir=os.path.join(out_dir, "logs_fleet"))
+    fleet.start()
+    try:
+        router = FleetRouter(fleet, faults=plan if plan_spec else None)
+        served, fleet_s, errs = _drive(router, specs,
+                                       threads=args.threads,
+                                       deadline_s=args.deadline)
+        # recovery: the supervisor must bring the killed replica BACK —
+        # wait for the fleet to return to full strength (the replacement
+        # warms from the shared persistent compilation cache)
+        recovered = True
+        if not args.no_faults:
+            t_end = time.monotonic() + args.deadline
+            while fleet.healthy_count() < args.replicas:
+                if time.monotonic() > t_end:
+                    recovered = False
+                    break
+                time.sleep(0.2)
+        # surviving replicas: the per-replica single-compile guard over
+        # the grown /healthz (counts are per-process, so a restarted
+        # replica legitimately reports fresh counts — still all == 1)
+        import urllib.request
+
+        compile_ok, compile_counts = True, {}
+        for rid, url in fleet.endpoints():
+            with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+                h = json.loads(r.read())
+            compile_counts[str(rid)] = h["compile_counts"]
+            if any(c != 1 for c in h["compile_counts"].values()):
+                compile_ok = False
+        restarts = sum(fleet.health()["restarts"].values())
+        stats = router.stats()
+    finally:
+        fleet.drain()
+
+    # -- verdict ---------------------------------------------------------
+    mismatches = [i for i in served if served[i] != solo[i]]
+    cache = ResultCache(fleet_cache, verify=True)
+    verified, dropped = cache.verified, cache.dropped
+    entries = len(cache)
+    claims = os.listdir(os.path.join(fleet_cache, "claims"))
+    tmps = [n for n in os.listdir(os.path.join(fleet_cache, "results"))
+            if n.endswith(".tmp")]
+    cache.close()
+    kill_fired = plan.shots_fired("replica.kill") if plan_spec else 0
+
+    verdict = {
+        "mode": "chaos",
+        "requests": len(specs),
+        "replicas": args.replicas,
+        "completed": len(served),
+        "errors": errs,
+        "byte_identical": not mismatches and len(served) == len(specs),
+        "mismatches": mismatches,
+        "entries": entries,
+        "verified": verified,
+        "lost_commits": dropped,
+        "leaked_claims": claims,
+        "leaked_tmps": tmps,
+        "compile_ok": compile_ok,
+        "compile_counts": compile_counts,
+        "kill_fired": kill_fired,
+        "recovered": recovered,
+        "failovers": stats["failovers"],
+        "routed": stats["routed"],
+        "per_replica": stats["per_replica"],
+        "restarts": restarts,
+        "solo_req_per_sec": round(len(specs) / solo_s, 2),
+        "fleet_req_per_sec": round(len(specs) / fleet_s, 2),
+        "fleet_over_solo": round(solo_s / fleet_s, 2),
+    }
+    verdict["ok"] = bool(
+        verdict["byte_identical"] and not errs
+        and dropped == 0 and entries == len(specs)
+        and not claims and not tmps and compile_ok
+        and (args.no_faults or (kill_fired >= 1
+                                and stats["failovers"] >= 1
+                                and restarts >= 1 and recovered)))
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+# multi-process cache contention stress
+# ---------------------------------------------------------------------------
+
+
+def _stress_hash(j):
+    """Deterministic hash pool shared by every worker."""
+    return hashlib.sha256(f"stress-{j}".encode()).hexdigest()
+
+
+def _stress_array(j):
+    import numpy as np
+
+    return np.full((3, 16), float(j), np.float32)
+
+
+def run_stress_worker(args):
+    """One contending process: overlapping put/get of a shared hash pool
+    (every worker writes IDENTICAL content per hash — the serving
+    contract — so any byte divergence is a torn commit)."""
+    from psrsigsim_tpu.runtime import FaultPlan
+    from psrsigsim_tpu.serve import ResultCache
+
+    faults = None
+    if args.plan:
+        with open(args.plan) as f:
+            spec = json.load(f)
+        faults = FaultPlan(spec["scratch_dir"], spec["spec"])
+    cache = ResultCache(args.out, faults=faults, claim_timeout_s=2.0)
+    for k in range(args.puts):
+        j = (args.worker_id + k) % args.hashes
+        h = _stress_hash(j)
+        rec = cache.put(h, _stress_array(j))
+        if rec["hash"] != h:
+            return {"ok": False, "error": f"bad record for {h[:8]}"}
+        got = cache.get(_stress_hash((j + 1) % args.hashes))
+        if got is not None and got[0, 0] != float((j + 1) % args.hashes):
+            return {"ok": False,
+                    "error": f"torn read of hash {(j + 1) % args.hashes}"}
+    cache.close()
+    return {"ok": True, "worker": args.worker_id}
+
+
+def run_cache_stress(args):
+    from psrsigsim_tpu.serve import ResultCache
+
+    out_dir = os.path.abspath(args.out)
+    shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir)
+    plan_path = None
+    if not args.no_faults:
+        plan_path = os.path.join(out_dir, "plan.json")
+        with open(plan_path, "w") as f:
+            json.dump({"scratch_dir": os.path.join(out_dir, "scratch"),
+                       "spec": {"cache.contend":
+                                {"hold_s": 0.05, "times": args.workers}}},
+                      f)
+    cache_dir = os.path.join(out_dir, "cache")
+    procs = []
+    for w in range(args.workers):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--mode", "stress-worker", "--out", cache_dir,
+               "--worker-id", str(w), "--puts", str(args.puts),
+               "--hashes", str(args.hashes)]
+        if plan_path:
+            cmd += ["--plan", plan_path]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.DEVNULL, text=True))
+    worker_fail = []
+    for w, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        try:
+            v = json.loads(out.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            v = {"ok": False, "error": f"no verdict (rc={p.returncode})"}
+        if p.returncode != 0 or not v.get("ok"):
+            worker_fail.append({"worker": w, **v})
+
+    # consistency audit from a FRESH reader over the shared dir
+    cache = ResultCache(cache_dir, verify=True)
+    n_expect = len({(w + k) % args.hashes
+                    for w in range(args.workers)
+                    for k in range(args.puts)})
+    torn = []
+    for j in range(args.hashes):
+        got = cache.get(_stress_hash(j))
+        if got is None:
+            continue
+        if got.tobytes() != _stress_array(j).tobytes():
+            torn.append(j)
+    entries = len(cache)
+    dropped = cache.dropped
+    stats = cache.stats()
+    cache.close()
+    claims = os.listdir(os.path.join(cache_dir, "claims"))
+    tmps = [n for n in os.listdir(os.path.join(cache_dir, "results"))
+            if n.endswith(".tmp")]
+    with open(os.path.join(cache_dir, "cache_journal.jsonl")) as f:
+        put_lines = [json.loads(l) for l in f if l.strip()]
+    puts_per_hash = {}
+    for rec in put_lines:
+        if rec.get("e") == "put":
+            puts_per_hash[rec["hash"]] = puts_per_hash.get(rec["hash"], 0) + 1
+    dup_commits = {h[:8]: c for h, c in puts_per_hash.items() if c != 1}
+
+    verdict = {
+        "mode": "cache-stress",
+        "workers": args.workers,
+        "puts_per_worker": args.puts,
+        "hash_pool": args.hashes,
+        "entries": entries,
+        "expected_entries": n_expect,
+        "dropped": dropped,
+        "torn": torn,
+        "dup_commits": dup_commits,
+        "leaked_claims": claims,
+        "leaked_tmps": tmps,
+        "worker_failures": worker_fail,
+        "claim_breaks": stats["claim_breaks"],
+    }
+    verdict["ok"] = bool(
+        not worker_fail and not torn and not dup_commits
+        and not claims and not tmps and dropped == 0
+        and entries == n_expect)
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="chaos",
+                    choices=["chaos", "cache-stress", "stress-worker"])
+    ap.add_argument("--out", required=True,
+                    help="work dir (chaos/stress) or cache dir (worker)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--kill-after", type=int, default=2)
+    ap.add_argument("--threads", type=int, default=3)
+    ap.add_argument("--deadline", type=float, default=300.0)
+    ap.add_argument("--widths", default="1")
+    ap.add_argument("--blackhole", action="store_true",
+                    help="also arm one route.blackhole shot")
+    ap.add_argument("--no-faults", action="store_true")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--puts", type=int, default=24)
+    ap.add_argument("--hashes", type=int, default=8)
+    ap.add_argument("--worker-id", type=int, default=0)
+    ap.add_argument("--plan", default=None)
+    args = ap.parse_args(argv)
+
+    # keep stdout clean for the one-line verdict protocol
+    real_stdout = sys.stdout
+    sys.stdout = sys.stderr
+    if args.mode == "chaos":
+        verdict = run_chaos(args)
+    elif args.mode == "cache-stress":
+        verdict = run_cache_stress(args)
+    else:
+        verdict = run_stress_worker(args)
+    print(json.dumps(verdict), file=real_stdout, flush=True)
+    return 0 if verdict.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,475 @@
+"""Replicated-fleet tests: cross-process cache commit discipline,
+retry jitter, replica supervision, consistent routing with failover,
+and the subprocess chaos/stress proofs (tests/fleet_runner.py).
+
+The router/fleet unit tests run against stub fleets and injected
+transports — no sockets, no JAX; the subprocess proofs launch real
+servers (``faults`` marker, PR-2 style).
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.runtime import ProcessSupervisor, RetryPolicy
+from psrsigsim_tpu.runtime.faults import FaultPlan
+from psrsigsim_tpu.serve import FleetRouter, RequestRejected, ResultCache
+from psrsigsim_tpu.serve.router import RouteFailed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "fleet_runner.py")
+
+#: a valid minimal spec for router tests (canonicalization is real)
+SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05, "seed": 3, "dm": 10.0,
+}
+
+
+# ---------------------------------------------------------------------------
+# retry jitter (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryJitter:
+    def test_default_is_exact_deterministic_schedule(self):
+        p = RetryPolicy(max_attempts=4, base_delay=0.5, max_delay=30.0)
+        assert p.delays() == [0.5, 1.0, 2.0]
+
+    def test_injected_rng_reproducible_and_bounded(self):
+        mk = lambda seed: RetryPolicy(max_attempts=6, base_delay=0.5,
+                                      max_delay=30.0, jitter=0.5,
+                                      rng=random.Random(seed).random)
+        assert mk(7).delays() == mk(7).delays()
+        assert mk(7).delays() != mk(8).delays()      # decorrelated fleets
+        det = RetryPolicy(max_attempts=6, base_delay=0.5, max_delay=30.0)
+        for d, dd in zip(mk(7).delays(), det.delays()):
+            assert dd * 0.5 <= d <= min(30.0, dd * 1.5)
+
+    def test_jitter_band_respects_max_delay_cap(self):
+        p = RetryPolicy(max_attempts=12, base_delay=1.0, max_delay=4.0,
+                        jitter=1.0, rng=random.Random(1).random)
+        assert all(d <= 4.0 for d in p.delays())
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.5)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=-0.1)
+
+
+# ---------------------------------------------------------------------------
+# cross-process cache commit discipline (tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestSharedCacheTier:
+    def test_peer_commit_visible_without_reopen(self, tmp_path):
+        """Two cache instances over one dir (flock excludes even
+        same-process instances): a commit by one is served by the other
+        via the journal-tail refresh — the shared-tier contract."""
+        d = str(tmp_path / "c")
+        a, b = ResultCache(d), ResultCache(d)
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        a.put("aa" * 32, arr)
+        got = b.get("aa" * 32)
+        assert got is not None and got.tobytes() == arr.tobytes()
+        a.close(), b.close()
+
+    def test_duplicate_put_is_benign_noop(self, tmp_path):
+        d = str(tmp_path / "c")
+        a, b = ResultCache(d), ResultCache(d)
+        arr = np.ones(4, np.float32)
+        ra = a.put("aa" * 32, arr)
+        rb = b.put("aa" * 32, arr)          # concurrent duplicate
+        assert ra["sha256"] == rb["sha256"]
+        with open(os.path.join(d, "cache_journal.jsonl")) as f:
+            puts = [json.loads(l) for l in f if json.loads(l)["e"] == "put"]
+        assert len(puts) == 1               # exactly one committed record
+        a.close(), b.close()
+
+    def test_stale_claim_from_dead_writer_is_broken(self, tmp_path):
+        """A writer SIGKILL'd between artifact rename and journal append
+        leaves a claim marker and an unindexed file; the next writer for
+        that hash must break the claim and commit cleanly."""
+        d = str(tmp_path / "c")
+        h = "bb" * 32
+        c0 = ResultCache(d)
+        c0.close()
+        claim = os.path.join(d, "claims", f"{h}.claim")
+        with open(claim, "w") as f:
+            f.write("dead-writer")
+        os.utime(claim, (0, 0))             # ancient: instantly stale
+        c = ResultCache(d, claim_timeout_s=0.5)
+        rec = c.put(h, np.ones(3, np.float32))
+        assert rec["hash"] == h and c.claim_breaks == 1
+        assert not os.path.exists(claim)
+        assert c.get(h) is not None
+        c.close()
+
+    def test_reader_never_indexes_unjournaled_artifact(self, tmp_path):
+        """Commit order is artifact-then-journal: an artifact file with
+        no journal record (the mid-commit crash window) must be
+        invisible to readers."""
+        d = str(tmp_path / "c")
+        c = ResultCache(d)
+        orphan = os.path.join(d, "results", "cc" * 32 + ".npy")
+        np.save(orphan, np.zeros(3, np.float32))
+        assert c.get("cc" * 32) is None
+        c.close()
+        c2 = ResultCache(d, verify=True)
+        assert c2.get("cc" * 32) is None
+        c2.close()
+
+    def test_verify_drop_is_journaled_and_stays_dropped(self, tmp_path):
+        d = str(tmp_path / "c")
+        c = ResultCache(d)
+        c.put("aa" * 32, np.zeros(4, np.float32))
+        c.put("bb" * 32, np.ones(4, np.float32))
+        c.close()
+        path = os.path.join(d, "results", "aa" * 32 + ".npy")
+        with open(path, "r+b") as f:
+            f.seek(-2, os.SEEK_END)
+            f.write(b"XX")
+        c2 = ResultCache(d, verify=True)
+        assert c2.verified == 1 and c2.dropped == 1
+        c2.close()
+        # a LATER open (no verify) must not resurrect the dropped record
+        c3 = ResultCache(d)
+        assert c3.get("aa" * 32) is None
+        assert c3.get("bb" * 32) is not None
+        c3.close()
+
+    def test_concurrent_same_hash_puts_across_threads(self, tmp_path):
+        d = str(tmp_path / "c")
+        caches = [ResultCache(d) for _ in range(4)]
+        arr = np.full((2, 8), 7.0, np.float32)
+        errs = []
+
+        def put(c):
+            try:
+                c.put("dd" * 32, arr)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=put, args=(c,)) for c in caches]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs
+        with open(os.path.join(d, "cache_journal.jsonl")) as f:
+            puts = [l for l in f if '"put"' in l]
+        assert len(puts) == 1
+        for c in caches:
+            got = c.get("dd" * 32)
+            assert got is not None and got.tobytes() == arr.tobytes()
+            c.close()
+
+
+class TestJournalCompaction:
+    def _churn(self, d, n):
+        """Commit n artifacts then verify-drop them all (dead records)."""
+        c = ResultCache(d)
+        for i in range(n):
+            c.put(f"{i:02x}" * 32, np.zeros(2, np.float32))
+        c.close()
+        for i in range(n):
+            p = os.path.join(d, "results", f"{i:02x}" * 32 + ".npy")
+            with open(p, "r+b") as f:
+                f.write(b"XX")
+        v = ResultCache(d, verify=True)
+        assert v.dropped == n
+        v.close()
+
+    def test_open_compacts_dead_history(self, tmp_path):
+        d = str(tmp_path / "c")
+        self._churn(d, 8)                      # 8 puts + 8 drops dead
+        jp = os.path.join(d, "cache_journal.jsonl")
+        assert len(open(jp).readlines()) == 16
+        c = ResultCache(d, compact_min_dead=8)
+        assert c.compacted == 16
+        assert open(jp).readlines() == []      # nothing live survived
+        c.close()
+
+    def test_restart_count_journal_stays_bounded(self, tmp_path):
+        """The satellite pin: repeated churn + reopen cycles must NOT
+        grow the journal without bound — each open compacts once the
+        dead-record count passes the threshold."""
+        d = str(tmp_path / "c")
+        sizes = []
+        for cycle in range(5):
+            c = ResultCache(d, compact_min_dead=6)
+            for i in range(4):
+                c.put(f"{cycle:02d}{i:02d}" + "ef" * 30,
+                      np.zeros(2, np.float32))
+            # drop this cycle's artifacts so history is all dead
+            for i in range(4):
+                p = os.path.join(d, "results",
+                                 f"{cycle:02d}{i:02d}" + "ef" * 30 + ".npy")
+                with open(p, "r+b") as f:
+                    f.write(b"XX")
+            c.close()
+            v = ResultCache(d, verify=True, compact_min_dead=6)
+            v.close()
+            jp = os.path.join(d, "cache_journal.jsonl")
+            sizes.append(len(open(jp).readlines()))
+        # without compaction this grows by 8 lines per cycle (4 puts +
+        # 4 drops); with it, every open clears the dead history
+        assert max(sizes) <= 14, sizes
+        assert sizes[-1] <= 14, sizes
+
+    def test_live_entries_survive_compaction_and_peers_refresh(
+            self, tmp_path):
+        d = str(tmp_path / "c")
+        keep = ResultCache(d)
+        keep.put("aa" * 32, np.ones(3, np.float32))   # stays live
+        self._churn(d, 8)
+        c = ResultCache(d, compact_min_dead=8)        # compacts
+        assert c.get("aa" * 32) is not None
+        # the pre-compaction instance appends through the new inode and
+        # refreshes across the swap
+        keep.put("bb" * 32, np.zeros(3, np.float32))
+        assert c.get("bb" * 32) is not None
+        keep.close(), c.close()
+
+
+# ---------------------------------------------------------------------------
+# replica supervision
+# ---------------------------------------------------------------------------
+
+
+class TestProcessSupervisor:
+    def test_restart_after_kill_and_clean_stop(self):
+        sup = ProcessSupervisor(
+            "t", lambda: subprocess.Popen(
+                [sys.executable, "-c", "import time; time.sleep(60)"]),
+            policy=RetryPolicy(max_attempts=5, base_delay=0.05,
+                               max_delay=0.1))
+        sup.start()
+        assert sup.alive()
+        pid1 = sup.pid
+        sup.kill()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if sup.alive() and sup.restarts == 1:
+                break
+            time.sleep(0.05)
+        assert sup.alive() and sup.pid != pid1 and sup.restarts == 1
+        sup.stop()
+        assert not sup.alive() and not sup.failed
+
+    def test_flapping_child_exhausts_policy_and_fails(self):
+        spawns = []
+
+        def spawn():
+            p = subprocess.Popen([sys.executable, "-c", "pass"])
+            spawns.append(p.pid)
+            return p
+
+        sup = ProcessSupervisor(
+            "flap", spawn,
+            policy=RetryPolicy(max_attempts=3, base_delay=0.01,
+                               max_delay=0.02))
+        sup.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and not sup.failed:
+            time.sleep(0.05)
+        assert sup.failed and len(spawns) == 3
+        assert not sup.alive()
+
+
+# ---------------------------------------------------------------------------
+# consistent routing + failover (stub fleet, injected transport)
+# ---------------------------------------------------------------------------
+
+
+class _StubFleet:
+    """An in-memory fleet: live replica ids with fake urls, a kill log,
+    and per-replica behavior installed by the test."""
+
+    def __init__(self, ids, quorum=1):
+        self.live = {i: f"stub://replica{i}" for i in ids}
+        self.quorum = quorum
+        self.killed = []
+
+    def endpoints(self):
+        return sorted(self.live.items())
+
+    def has_quorum(self):
+        return len(self.live) >= self.quorum
+
+    def kill_replica(self, i, sig=None):
+        self.killed.append(i)
+        self.live.pop(i, None)
+
+    def health(self):
+        return {"ok": self.has_quorum(), "healthy": len(self.live)}
+
+
+def _ok_transport(log):
+    def transport(method, url, body, timeout):
+        log.append((method, url))
+        return 200, {"status": "done", "url": url,
+                     "profile": [[1.0]], "id": "x"}
+    return transport
+
+
+class TestFleetRouter:
+    def test_routing_is_consistent_and_coalesces_identical_specs(self):
+        fleet = _StubFleet([0, 1, 2])
+        log = []
+        r = FleetRouter(fleet, transport=_ok_transport(log))
+        s1, b1 = r.submit(SPEC, deadline_s=5)
+        s2, b2 = r.submit(dict(SPEC), deadline_s=5)   # identical content
+        assert b1["url"] == b2["url"]                 # same replica: coalesce
+        # distinct specs spread (statistically certain over 32 seeds)
+        urls = set()
+        for seed in range(32):
+            _, b = r.submit(dict(SPEC, seed=seed), deadline_s=5)
+            urls.add(b["url"])
+        assert len(urls) == 3
+
+    def test_death_moves_only_the_dead_replicas_keys(self):
+        fleet = _StubFleet([0, 1, 2])
+        r = FleetRouter(fleet, transport=_ok_transport([]))
+        owners = {s: r.route(f"{s:064x}")[0] for s in range(64)}
+        dead = 1
+        fleet.live.pop(dead)
+        for s, owner in owners.items():
+            new_owner = r.route(f"{s:064x}")[0]
+            if owner != dead:
+                assert new_owner == owner     # surviving keys unmoved
+            else:
+                assert new_owner != dead
+
+    def test_failover_preserves_deadline_and_reroutes(self):
+        fleet = _StubFleet([0, 1])
+        calls = []
+
+        def transport(method, url, body, timeout):
+            calls.append((url, json.loads(body)["deadline_s"], timeout))
+            if len(calls) == 1:
+                time.sleep(0.2)
+                raise ConnectionError("replica died mid-request")
+            return 200, {"status": "done", "url": url, "profile": [[1.0]]}
+
+        r = FleetRouter(fleet, transport=transport)
+        status, resp = r.submit(SPEC, deadline_s=30)
+        assert status == 200
+        assert len(calls) == 2 and calls[0][0] != calls[1][0]
+        # the re-route carried the REMAINING budget, not a fresh one
+        assert calls[1][1] < calls[0][1] - 0.15
+        assert r.stats()["failovers"] == 1
+
+    def test_below_quorum_rejects_with_backpressure(self):
+        fleet = _StubFleet([0, 1], quorum=2)
+        r = FleetRouter(fleet, transport=_ok_transport([]))
+        fleet.live.pop(0)
+        with pytest.raises(RequestRejected) as err:
+            r.submit(SPEC, deadline_s=5)
+        assert err.value.retry_after_s > 0
+        assert r.stats()["rejected"] == 1
+
+    def test_route_blackhole_fault_forces_failover(self, tmp_path):
+        fleet = _StubFleet([0, 1, 2])
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"route.blackhole": {"times": 1}})
+        log = []
+        r = FleetRouter(fleet, faults=plan, transport=_ok_transport(log))
+        status, _ = r.submit(SPEC, deadline_s=10)
+        assert status == 200
+        st = r.stats()
+        assert st["blackholed"] == 1 and st["failovers"] == 1
+        assert plan.shots_fired("route.blackhole") == 1
+        # replica was NOT killed: a partition is not a death
+        assert fleet.killed == []
+
+    def test_replica_kill_fault_fires_before_forward(self, tmp_path):
+        fleet = _StubFleet([0, 1, 2])
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"replica.kill": {"after_requests": 2}})
+        seen = []
+
+        def transport(method, url, body, timeout):
+            rid = int(url.split("replica")[1].split("/")[0])
+            if rid not in fleet.live:
+                raise ConnectionError("killed")
+            seen.append(rid)
+            return 200, {"status": "done", "profile": [[1.0]]}
+
+        r = FleetRouter(fleet, faults=plan, transport=transport)
+        for i in range(4):
+            status, _ = r.submit(dict(SPEC, seed=i), deadline_s=10)
+            assert status == 200
+        st = r.stats()
+        assert st["kills_fired"] == 1 and len(fleet.killed) == 1
+        assert st["routed"] == 4          # every request still completed
+        assert st["failovers"] >= 1       # the victim's request re-routed
+
+    def test_deadline_exhaustion_raises_route_failed(self):
+        fleet = _StubFleet([0])
+
+        def transport(method, url, body, timeout):
+            raise ConnectionError("always down")
+
+        r = FleetRouter(fleet, transport=transport)
+        with pytest.raises(RouteFailed):
+            r.submit(SPEC, deadline_s=0.3)
+
+
+# ---------------------------------------------------------------------------
+# subprocess proofs (PR-2 style)
+# ---------------------------------------------------------------------------
+
+
+def _run_runner(args, timeout):
+    proc = subprocess.run(
+        [sys.executable, RUNNER, *args], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, timeout=timeout)
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert lines, "runner produced no verdict"
+    return json.loads(lines[-1]), proc.returncode
+
+
+@pytest.mark.faults
+class TestFleetProofs:
+    def test_multiprocess_cache_contention(self, tmp_path):
+        """The satellite stress pin: 4 processes hammer one cache dir
+        with overlapping put/get of identical and distinct hashes
+        (cache.contend dwells inside the commit window); the audit must
+        find a consistent index, no torn artifacts, and exactly one
+        committed artifact per hash."""
+        verdict, rc = _run_runner(
+            ["--mode", "cache-stress", "--out", str(tmp_path / "s"),
+             "--workers", "4", "--puts", "24", "--hashes", "8"],
+            timeout=600)
+        assert rc == 0 and verdict["ok"], verdict
+        assert verdict["dup_commits"] == {} and verdict["torn"] == []
+        assert verdict["entries"] == verdict["expected_entries"]
+
+    @pytest.mark.slow
+    def test_chaos_replica_kill_byte_identity(self, tmp_path):
+        """The acceptance pin: replica.kill SIGKILLs a routed replica
+        mid-traffic; every accepted request completes byte-identical to
+        the solo run, zero committed artifacts are lost, each surviving
+        replica compiled each program at most once, and the supervisor
+        restarted the corpse."""
+        verdict, rc = _run_runner(
+            ["--mode", "chaos", "--out", str(tmp_path / "c"),
+             "--replicas", "2", "--requests", "6", "--kill-after", "2",
+             "--threads", "3"],
+            timeout=560)
+        assert rc == 0 and verdict["ok"], verdict
+        assert verdict["byte_identical"] is True
+        assert verdict["lost_commits"] == 0
+        assert verdict["compile_ok"] is True
+        assert verdict["kill_fired"] >= 1 and verdict["restarts"] >= 1
