@@ -4,7 +4,10 @@
 # tier-1 (`make test`) AND is addressable on its own (`make test-faults`).
 # `make bench-export` is the quick streaming-export gate: pipelined vs
 # serial byte identity, pipeline >= serial throughput, stage timers
-# present, compute slope resolvable (bench.py export_smoke).
+# present, compute slope resolvable, packed >= per-file sustained write
+# rate under comparable-bytes loops, shared-registry single-build per
+# geometry, and per-pulsar grouped packed (per-obs DM) byte correctness
+# (bench.py export_smoke).
 # `make bench-mc` is the Monte-Carlo study-engine gate: bit-identical
 # merged statistics + artifact fingerprints at trial-chunk sizes
 # {32,128,512}, interrupted-sweep resume identity, stage timers present

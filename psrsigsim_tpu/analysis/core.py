@@ -104,7 +104,7 @@ class LintConfig:
     rng_sinks: tuple = ("chi2_sample", "normal_sample", "blocked_chan_chi2",
                         "blocked_chan_normal", "chan_chi2_field",
                         "chan_normal_field", "flat_normal_field",
-                        "hw_chan_field")
+                        "flat_chi2_field", "hw_chan_field")
     # axis names beyond those discovered in parallel/mesh.py (the seq
     # pipeline defines its own 1-D mesh in parallel/seqshard.py)
     mesh_axes_extra: tuple = ("seq",)

@@ -192,27 +192,77 @@ def _write_obs_full(state, path, triple, dm):
         timers.add("write", _time.perf_counter() - t0)
 
 
+def _stream_chunk_bytes():
+    """Bounded buffer size of the streamed group writes (bytes).  Packed
+    groups are tens of MB per file; feeding the kernel bounded slices
+    instead of one whole-file burst keeps the dirty-page window per file
+    small (a single multi-MB ``writev`` can stall on writeback
+    throttling mid-call) while staying gathered enough that the syscall
+    count is negligible.  ``PSS_EXPORT_STREAM_MB`` overrides (floor
+    64 KiB)."""
+    try:
+        mb = float(os.environ.get("PSS_EXPORT_STREAM_MB", "8"))
+    except ValueError:
+        mb = 8.0
+    return max(1 << 16, int(mb * (1 << 20)))
+
+
+def _iov_batches(bufs, chunk_bytes):
+    """Slice a buffer sequence into bounded ``writev`` batches: each
+    yielded batch is a list of memoryviews totaling at most
+    ``chunk_bytes`` (the last one smaller).  Zero-copy — every view
+    aliases the caller's buffers."""
+    batch, size = [], 0
+    for b in bufs:
+        mv = memoryview(b)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        off = 0
+        while off < len(mv):
+            take = min(len(mv) - off, chunk_bytes - size)
+            batch.append(mv[off:off + take])
+            size += take
+            off += take
+            if size >= chunk_bytes:
+                yield batch
+                batch, size = [], 0
+    if batch:
+        yield batch
+
+
 class _FastObsWriter:
     """Byte-prototype bulk writer for quantized PSR exports.
 
     Every file of a bulk export shares its epochs, polycos, par file, and
     all header/table structure; only the SUBINT table's DAT_SCL /
-    DAT_OFFS / DATA columns carry the observation (and CHAN_DM/DM when
-    per-observation DMs are passed, which this fast path defers to the
-    full pipeline).  So: the FIRST observation is written by the full
+    DAT_OFFS / DATA columns carry the observation — and, for
+    per-observation-DM exports, the handful of DM header/table fields.
+    So: the FIRST file of each (geometry, DM) is written by the full
     :meth:`PSRFITS.save` assembly, read back, and kept as a prototype
     whose three columns are refilled per file — a handful of vectorized
-    copies plus one write() instead of ~8k python calls of FITS assembly
-    (the measured bulk-export host-write bound, BENCH_r03/r04
-    ``host_write_s_per_obs``).  Byte-for-byte identical to the full path
-    (tests/test_export.py)."""
+    copies plus bounded gathered writes instead of ~8k python calls of
+    FITS assembly (the measured bulk-export host-write bound,
+    BENCH_r03/r04 ``host_write_s_per_obs``).  Byte-for-byte identical to
+    the full path (tests/test_export.py).
+
+    Prototypes are keyed by ``(payload shape, DM)``: a DM change patches
+    CHAN_DM/DM header cards and the HISTORY row, so each distinct DM
+    needs its own prototype — which makes the per-pulsar grouped packed
+    export (one DM per file, many files per DM) pay full assembly once
+    per pulsar instead of once per file.  The cache is LRU-bounded
+    (``proto_cache`` in the writer state, default 8): packed prototypes
+    hold a whole file's record array, and the grouped exporter visits
+    DMs in runs, so a small cache hits essentially always."""
 
     def __init__(self, state):
+        from collections import OrderedDict
+
         self._state = state
-        # keyed by the triple's (nsub_rows, nchan, nbin): packed exports
+        # LRU keyed by ((nsub_rows, nchan, nbin), dm): packed exports
         # end with one short final group whose geometry differs from the
-        # full groups', and each geometry needs its own prototype
-        self._protos = {}
+        # full groups', and each (geometry, DM) needs its own prototype
+        self._protos = OrderedDict()
+        self._max_protos = max(1, int(state.get("proto_cache") or 8))
 
     def write(self, path, triple, dm):
         """Write one file; returns its sha256 when the state records
@@ -220,17 +270,16 @@ class _FastObsWriter:
         otherwise — the caller falls back to hashing the file)."""
         import time as _time
 
-        if dm is not None:
-            # per-observation DMs patch headers too: keep the one full
-            # pipeline as the single source of truth for that rare path
-            _write_obs_full(self._state, path, triple, dm)
-            return None
         shape = tuple(np.asarray(triple[0]).shape)
-        proto = self._protos.get(shape)
+        pkey = (shape, None if dm is None else float(dm))
+        proto = self._protos.get(pkey)
         if proto is None:
             _write_obs_full(self._state, path, triple, dm)
-            self._protos[shape] = self._init_proto(path)
+            self._protos[pkey] = self._init_proto(path)
+            while len(self._protos) > self._max_protos:
+                self._protos.popitem(last=False)
             return None
+        self._protos.move_to_end(pkey)
         timers = self._state.get("timers")
         t0 = _time.perf_counter()
         pre, sub, post, pad = proto
@@ -276,11 +325,21 @@ class _FastObsWriter:
             crash_process()
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
         try:
-            # one gathered syscall; the array's raw buffer is the FITS
-            # payload already (on-disk big-endian layout from read).
-            # A short write (disk full, RLIMIT_FSIZE) must NOT reach the
-            # rename — resume treats existing files as complete.
-            written = os.writev(fd, bufs)
+            # streamed gathered writes: bounded memoryview batches over
+            # the same buffers (the arrays' raw bytes ARE the on-disk
+            # big-endian FITS payload already), so a one-obs file is
+            # still a single writev while a packed group streams in
+            # bounded slices instead of one whole-file burst.  A short
+            # write (disk full, RLIMIT_FSIZE) must NOT reach the rename —
+            # resume treats existing files as complete.
+            written = 0
+            for batch in _iov_batches(bufs, _stream_chunk_bytes()):
+                n = os.writev(fd, batch)
+                want = sum(len(b) for b in batch)
+                written += n
+                if n != want:
+                    raise IOError(
+                        f"short write to {tmp}: {written}/{total} bytes")
             if written != total:
                 raise IOError(
                     f"short write to {tmp}: {written}/{total} bytes")
@@ -897,8 +956,20 @@ def _check_manifest(out_dir, fp, resume):
 
 
 class _GroupPacker:
-    """Accumulate per-observation quantized triples into ``obs_per_file``
-    groups packed along the subint axis.
+    """Accumulate per-observation quantized triples into packed file
+    groups along the subint axis.
+
+    Group spans are uniform ``obs_per_file`` slices when every
+    observation shares one DM, and **per-pulsar/DM runs** otherwise: with
+    per-observation ``dms``, consecutive observations with the SAME DM
+    form a run (the heterogeneous multi-pulsar layout — pulsar-major
+    observation order, one DM per pulsar), each run is cut into
+    ``obs_per_file``-sized groups, and every group therefore holds ONE
+    source — the physically correct PSRFITS shape (a file carries a
+    single CHAN_DM/DM header).  The spans are a pure function of
+    ``(n_obs, obs_per_file, dms)``, all three fingerprinted in the export
+    manifest, so a resumed export regroups identically and group-level
+    journaling stays byte-stable.
 
     Chunk boundaries from :meth:`FoldEnsemble.iter_chunks` need not align
     with file groups (chunk sizes round to the mesh's obs-shard count), so
@@ -906,16 +977,36 @@ class _GroupPacker:
     is written once its last observation lands.  Bounded memory: at most
     the groups overlapping one chunk are buffered."""
 
-    def __init__(self, n_obs, obs_per_file):
+    def __init__(self, n_obs, obs_per_file, dms=None):
         self.n_obs = int(n_obs)
         self.opf = int(obs_per_file)
+        if dms is None or self.opf == 1 or self.n_obs == 0:
+            firsts = np.arange(0, self.n_obs, self.opf, dtype=np.int64)
+        else:
+            d = np.asarray(dms, np.float64)
+            edges = np.flatnonzero(d[1:] != d[:-1]) + 1
+            run_lo = np.concatenate([[0], edges])
+            run_hi = np.concatenate([edges, [self.n_obs]])
+            firsts = np.concatenate(
+                [np.arange(a, b, self.opf) for a, b in zip(run_lo, run_hi)])
+        # span starts plus the terminal sentinel: group g spans
+        # [_firsts[g], _firsts[g+1])
+        self._firsts = np.concatenate(
+            [firsts, [self.n_obs]]).astype(np.int64)
         # group index -> [preallocated (data, scl, offs) buffers, filled
         # bool-per-obs]; buffers are handed out on completion, never reused
         self._buf = {}
 
+    @property
+    def n_groups(self):
+        return len(self._firsts) - 1
+
+    def group_of(self, i):
+        """The group index holding global observation ``i``."""
+        return int(np.searchsorted(self._firsts, i, side="right") - 1)
+
     def group_span(self, g):
-        first = g * self.opf
-        return first, min(first + self.opf, self.n_obs)
+        return int(self._firsts[g]), int(self._firsts[g + 1])
 
     def add_chunk(self, start, triple, skip_group=None):
         """Feed one fetched chunk; yield ``(group_index, packed_triple)``
@@ -939,7 +1030,8 @@ class _GroupPacker:
         export when a sibling group forced one of its chunks to run)."""
         data, scl, offs = (np.asarray(a) for a in triple)
         count = data.shape[0]
-        for g in range(start // self.opf, (start + count - 1) // self.opf + 1):
+        for g in range(self.group_of(start),
+                       self.group_of(start + count - 1) + 1):
             if skip_group is not None and skip_group(g):
                 continue
             first, end = self.group_span(g)
@@ -1016,8 +1108,12 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             export of the same seed.  Per-file header overhead (the
             measured host-write bound of one-obs files, BENCH_r04
             ``host_write_s_per_obs``) is amortized ``obs_per_file``-fold.
-            Incompatible with per-observation ``dms`` (a file carries one
-            CHAN_DM/DM header).
+            With per-observation ``dms``, groups are cut at every DM
+            change (per-pulsar grouped packing: consecutive observations
+            sharing a DM — the heterogeneous multi-pulsar layout — pack
+            together, so every file still carries ONE CHAN_DM/DM header;
+            see :class:`_GroupPacker`).  All-distinct DMs degenerate to
+            one observation per file.
         supervisor: optional
             :class:`psrsigsim_tpu.runtime.RunSupervisor` — arms the
             fault-tolerant run loop: per-file sha256 journaling, hash-
@@ -1073,11 +1169,6 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     obs_per_file = int(obs_per_file)
     if obs_per_file < 1:
         raise ValueError("obs_per_file must be >= 1")
-    if obs_per_file > 1 and dms is not None:
-        raise ValueError(
-            "obs_per_file > 1 packs observations into one file with a "
-            "single CHAN_DM/DM header; per-observation dms need "
-            "obs_per_file=1")
     os.makedirs(out_dir, exist_ok=True)
     tmpl = template if isinstance(template, FitsFile) else FitsFile.read(template)
     sig = ens.signal_shell()
@@ -1105,15 +1196,15 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     if writers is None:
         writers = min(8, os.cpu_count() or 1)
 
-    packer = _GroupPacker(n_obs, obs_per_file)
-    n_files = -(-n_obs // obs_per_file)
+    dms_np = None if dms is None else np.asarray(dms, np.float64)
+    packer = _GroupPacker(n_obs, obs_per_file, dms=dms_np)
     width = max(5, len(str(n_obs - 1)))
     if obs_per_file == 1:
         paths = [os.path.join(out_dir, f"obs_{i:0{width}d}.fits")
                  for i in range(n_obs)]
     else:
         paths = []
-        for g in range(n_files):
+        for g in range(packer.n_groups):
             first, end = packer.group_span(g)
             paths.append(os.path.join(
                 out_dir, f"obs_{first:0{width}d}-{end - 1:0{width}d}.fits"))
@@ -1142,8 +1233,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             return file_done(paths[g])
 
         def skip(start, count):
-            g_lo = start // obs_per_file
-            g_hi = (start + count - 1) // obs_per_file
+            g_lo = packer.group_of(start)
+            g_hi = packer.group_of(start + count - 1)
             return all(skip_group(g) for g in range(g_lo, g_hi + 1))
 
     # the writer state carries a shallow COPY of the ensemble's signal
@@ -1174,7 +1265,6 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
              # parent-side stage timers: NOT shipped to spawn workers
              # (worker cost surfaces as the parent's write-stage wait)
              "timers": telemetry}
-    dms_np = None if dms is None else np.asarray(dms, np.float64)
 
     # the supervisor journals a chunk the moment its files are durably
     # written — from the pool's FIFO drain or straight after serial writes
@@ -1286,9 +1376,18 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                                for i in range(*packer.group_span(g)))]
             if not todo:
                 continue
+
+            def group_dm(g):
+                # per-pulsar grouped packing: every member of a group
+                # shares one DM by construction (_GroupPacker cuts at DM
+                # changes), so the group's file header carries it
+                if dms_np is None:
+                    return None
+                return float(dms_np[packer.group_span(g)[0]])
+
             if pool is None:
                 for g, packed in todo:
-                    sha = _write_obs(state, paths[g], packed, None)
+                    sha = _write_obs(state, paths[g], packed, group_dm(g))
                     serial_commit(("group", g, [paths[g]]),
                                   [(paths[g], sha)])
                 continue
@@ -1302,7 +1401,7 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                 stacked = tuple(
                     np.stack([packed[i] for _, packed in items])
                     for i in range(3))
-                jobs = [(k, paths[g], None)
+                jobs = [(k, paths[g], group_dm(g))
                         for k, (g, _) in enumerate(items)]
                 pool.submit_chunk(
                     stacked, jobs,
@@ -1331,9 +1430,16 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                                         "write")):
         man = _load_manifest(out_dir)
         if man is not None:
+            from ..runtime.programs import global_registry
+
             man["pipeline"] = {"depth": pipeline_depth,
                                "writers": int(writers),
-                               "chunk_size": int(chunk_size), **snap}
+                               "chunk_size": int(chunk_size), **snap,
+                               # compile-count telemetry of the shared
+                               # program registry: how many programs
+                               # THIS process built (vs reused) to run
+                               # the export — the ROADMAP item 5 number
+                               "programs": global_registry().snapshot()}
             _write_manifest(out_dir, man)
     return paths
 
@@ -1350,7 +1456,7 @@ def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
     a recovered group's healthy rows stay bit-identical to an untroubled
     export; only the re-drawn observations differ (and are journaled)."""
     salt = supervisor.retry_fold_salt
-    groups = sorted({i // obs_per_file for i in bad_obs})
+    groups = sorted({packer.group_of(i) for i in bad_obs})
     want_rfi = getattr(ens, "_has_rfi", False)
     if not supervisor.retry_enabled:
         for g in groups:
@@ -1420,8 +1526,10 @@ def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
             for c in range(3))
         packed = (packed[0].view(">i2"), packed[1], packed[2])
         dm = None
-        if dms_np is not None and obs_per_file == 1:
-            dm = dms_np[members[0]]
+        if dms_np is not None:
+            # one DM per group by construction (per-pulsar grouping; for
+            # obs_per_file == 1 this is just the observation's own DM)
+            dm = float(dms_np[members[0]])
         sha = _write_obs(state, paths[g], packed, dm)
         supervisor.chunk_committed(("retry", g, [paths[g]]),
                                    [(paths[g], sha)])

@@ -15,9 +15,12 @@ to < 1e-6 cycles over the span (asserted by tests/test_timing.py); the
 model's absolute accuracy against a JPL-ephemeris fit is set by the
 analytic ephemeris (see :mod:`psrsigsim_tpu.io.ephem`).
 
-Models with terms that cannot be honored (glitches, TCB units, unknown
-binary models or site codes) raise :class:`UnsupportedTimingModelError`
-under ``strict=True`` rather than mispredicting silently.
+Models with terms that cannot be honored (unknown time-unit systems,
+unknown binary models or site codes, malformed glitch groups) raise
+:class:`UnsupportedTimingModelError` under ``strict=True`` rather than
+mispredicting silently.  ``UNITS TCB`` par files are accepted: the
+timing model converts them to TDB with the IAU scaling at construction
+(:func:`psrsigsim_tpu.io.timing.tcb_to_tdb_params`).
 """
 
 from __future__ import annotations
@@ -42,8 +45,10 @@ def check_par_supported(params, parfile="<par>"):
     """Raise :class:`UnsupportedTimingModelError` if ``params`` holds
     terms the numeric polyco fit cannot honor.  Round 2 rejected every
     binary/astrometric/DM-variation term; the numeric timing model now
-    covers those, so only glitches, FB series, TCB units, unknown binary
-    models, and unknown site codes remain unsupported."""
+    covers those (glitches and FB series landed in rounds 5-6, TCB
+    units convert to TDB in round 10), so only unknown unit systems,
+    unknown binary models, malformed glitch groups, and unknown site
+    codes remain unsupported."""
     check_model_supported(params, parfile=parfile)
 
 
@@ -75,8 +80,10 @@ def generate_polyco(parfile, MJD_start, segLength=60.0, ncoeff=15,
         ncoeff: number of coefficients (NCOEF).
         strict: when True (default), raise
             :class:`UnsupportedTimingModelError` for model terms that
-            cannot be honored (glitches, FB series, TCB units, unknown
-            binary models/site codes).  ``strict=False`` ignores them.
+            cannot be honored (unknown unit systems, unknown binary
+            models/site codes, malformed glitch groups).
+            ``strict=False`` ignores them.  TCB par files are honored
+            (converted to TDB at model construction).
         obs_freq: observing frequency in MHz for the dispersion terms
             (default: the par file's TZRFRQ).
         site: TEMPO observatory code the polyco is computed for

@@ -44,7 +44,8 @@ import numpy as np
 from ..utils.constants import _DM_K_VALUE as _DM_K  # s * MHz^2 / (pc cm^-3)
 from . import ephem
 
-__all__ = ["TimingModel", "parse_par_full", "UnsupportedTimingModelError"]
+__all__ = ["TimingModel", "parse_par_full", "UnsupportedTimingModelError",
+           "tcb_to_tdb_params"]
 
 _DEG = np.pi / 180.0
 _SEC_PER_DAY = 86400.0
@@ -120,11 +121,13 @@ def _parse_value(key, val):
 
 def check_model_supported(params, parfile="<par>"):
     """Raise :class:`UnsupportedTimingModelError` for terms that would be
-    silently mispredicted: TCB units, unknown binary models, unknown
-    glitch-family terms, incomplete glitch groups, unknown observatory
-    codes.  FB-series orbital-frequency derivatives (FB0..FBn) are
-    implemented (``_init_binary``/``_binary_delay_at``) — an FBn without
-    a BINARY model is still an orphan, caught below."""
+    silently mispredicted: unknown time units, unknown binary models,
+    unknown glitch-family terms, incomplete glitch groups, unknown
+    observatory codes.  FB-series orbital-frequency derivatives
+    (FB0..FBn) are implemented (``_init_binary``/``_binary_delay_at``);
+    ``UNITS TCB`` pars are accepted too — :class:`TimingModel` converts
+    them to TDB with the IAU scaling (:func:`tcb_to_tdb_params`) before
+    any evaluation — so only genuinely unknown unit systems reject."""
     bad = []
     glitch_idx = set()
     for key, val in params.items():
@@ -144,7 +147,7 @@ def check_model_supported(params, parfile="<par>"):
                 and not params.get(f"GLTD_{idx}", 0.0)):
             bad.append(f"GLF0D_{idx} (without GLTD_{idx})")
     units = str(params.get("UNITS", "TDB")).upper()
-    if units not in ("TDB", ""):
+    if units not in ("TDB", "TCB", ""):
         bad.append(f"UNITS={units}")
     binary = str(params.get("BINARY", "")).strip().upper()
     if binary and binary not in _BINARY_OK:
@@ -183,6 +186,98 @@ def check_model_supported(params, parfile="<par>"):
             "ignore them.")
 
 
+# IAU 2006 Resolution B3: TDB = TCB - L_B * (JD_TCB - T_0) * 86400 + TDB_0
+_TCB_L_B = 1.550519768e-8
+_TCB_T0_MJD = np.longdouble("43144.0003725")   # 1977 Jan 1.0 TAI
+_TCB_TDB0_S = -6.55e-5                          # seconds
+
+# time-dimension exponents of the scaled par quantities: a value with
+# units s^d transforms as  q_TDB = q_TCB * (1 - L_B)^d  (tempo2's
+# TCB->TDB transformation; frequencies d=-1, periods/amplitudes d=+1).
+# DM rides along because the dispersion DELAY is a time: with the
+# dispersion constant held fixed, DM_TDB = DM_TCB / (1 - L_B), and each
+# per-year derivative picks up one more inverse power.
+_TCB_SCALE_EXPONENTS = {
+    "PB": 1, "A1": 1, "GAMMA": 1, "H3": 1, "H4": 1, "M2": 1,
+    "EDOT": -1, "OMDOT": -1, "EPS1DOT": -1, "EPS2DOT": -1,
+    "DM": -1, "DM1": -2, "DM2": -3, "DM3": -4,
+}
+
+
+def _tcb_epoch_to_tdb(mjd):
+    """One absolute epoch, TCB MJD -> TDB MJD (longdouble)."""
+    t = np.longdouble(mjd)
+    return (t - np.longdouble(_TCB_L_B) * (t - _TCB_T0_MJD)
+            + np.longdouble(_TCB_TDB0_S) / np.longdouble(_SEC_PER_DAY))
+
+
+def tcb_to_tdb_params(params):
+    """Convert a parsed ``UNITS TCB`` par dict to TDB (IAU scaling).
+
+    TCB ticks faster than TDB by the defining constant
+    ``L_B = 1.550519768e-8`` (IAU 2006 B3), so a par file fit in TCB
+    carries epochs on a different clock and every dimensioned parameter
+    scaled by powers of ``(1 - L_B)``.  The standard transformation
+    (what ``tempo2 -upd`` / PINT apply):
+
+    * absolute epochs (PEPOCH, POSEPOCH, DMEPOCH, T0, TASC, TZRMJD,
+      glitch epochs, DMX range edges) map through
+      ``TDB = TCB - L_B (TCB - T_0) + TDB_0``;
+    * spin terms scale as frequencies, ``F_k -> F_k / (1-L_B)^(k+1)``,
+      and the FB orbital-frequency series and glitch F-terms likewise;
+    * periods/amplitudes measured in seconds (PB, A1, GAMMA, H3/H4,
+      M2·T_sun) scale by ``(1-L_B)``, rate terms by its inverse, and DM
+      (a delay in disguise) by ``1/(1-L_B)``.
+
+    Dimensionless terms (PBDOT, XDOT, SINI, angles, PX at our accuracy)
+    pass through.  Returns a NEW dict with ``UNITS`` set to ``TDB``;
+    spin/epoch arithmetic stays in longdouble so the round-trip against
+    an equivalently-fit TDB par agrees to <1e-6 cycles
+    (tests/test_timing.py)."""
+    one_minus = np.longdouble(1.0) - np.longdouble(_TCB_L_B)
+    out = dict(params)
+    out["UNITS"] = "TDB"
+
+    def _num(v):
+        return isinstance(v, (float, np.floating))
+
+    for key, val in params.items():
+        if not _num(val):
+            continue
+        if key in _LONGDOUBLE_KEYS or key.startswith(_LONGDOUBLE_PREFIXES):
+            out[key] = _tcb_epoch_to_tdb(val)
+            continue
+        if key in ("DMEPOCH",) or re.match(r"^DMXR[12]_\d+$", key):
+            out[key] = float(_tcb_epoch_to_tdb(val))
+            continue
+        m = re.match(r"^F(\d*)$", key)
+        if m:
+            k = int(m.group(1) or 0)
+            out[key] = float(np.longdouble(val) / one_minus ** (k + 1))
+            continue
+        m = re.match(r"^FB(\d+)$", key)
+        if m:
+            out[key] = float(
+                np.longdouble(val) / one_minus ** (int(m.group(1)) + 1))
+            continue
+        m = re.match(r"^GLF(0D|0|1|2)_(\d+)$", key)
+        if m:
+            order = {"0": 1, "0D": 1, "1": 2, "2": 3}[m.group(1)]
+            out[key] = float(np.longdouble(val) / one_minus ** order)
+            continue
+        if re.match(r"^GLTD_\d+$", key):
+            out[key] = float(np.longdouble(val) * one_minus)
+            continue
+        m = re.match(r"^DMX_\d+$", key)
+        if m:
+            out[key] = float(np.longdouble(val) / one_minus)
+            continue
+        exp = _TCB_SCALE_EXPONENTS.get(key)
+        if exp is not None:
+            out[key] = float(np.longdouble(val) * one_minus ** exp)
+    return out
+
+
 def _parse_sexagesimal(val, hours):
     """'hh:mm:ss.s' / 'dd:mm:ss.s' -> radians."""
     if isinstance(val, (float, np.floating)):
@@ -205,6 +300,11 @@ class TimingModel:
     memoizes them by file fingerprint); do not mutate a returned model."""
 
     def __init__(self, params, parfile="<par>", strict=True):
+        if str(params.get("UNITS", "TDB")).upper() == "TCB":
+            # the last loud-rejection class (now that FB-series landed):
+            # convert once at construction so every epoch/spin/binary
+            # term below is already TDB — DIVERGENCES #31
+            params = tcb_to_tdb_params(params)
         self.params = params
         self.parfile = parfile
         if strict:

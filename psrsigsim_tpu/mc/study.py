@@ -286,6 +286,30 @@ class MonteCarloStudy:
         self._obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
         self._programs = {}   # chunk width -> jitted chunk program
         self._param_fn = None  # jitted sampled-params program (lazy)
+        # program-shaping digest for the shared registry
+        # (runtime/programs.py): everything the trial program bakes in as
+        # constants (cfg scalars, priors, hist ranges, scenario defaults,
+        # dm/noise_norm/base_width) minus the purely-traced quantities
+        # (seed -> keys, n_trials -> indices).  Two studies with equal
+        # digests compile ONE trial program per chunk width between them.
+        _fp = dict(self.fingerprint(0))
+        _fp.pop("n_trials")
+        _fp.pop("seed")
+        # profiles are TRACED chunk-program inputs, not baked constants:
+        # two same-geometry studies with different templates share one
+        # compiled program, so their content hash stays out of the digest
+        _fp["config"] = {k: v for k, v in _fp["config"].items()
+                         if k != "profiles_sha256"}
+        # program-shaping geometry the MANIFEST fingerprint deliberately
+        # omits (it cannot change the sweep's bytes through the priors'
+        # fields alone, but scenario trial programs bake the band floor
+        # f_lo = fcent - bw/2 in as the scintle-cell origin): the digest
+        # must cover it or two same-prior studies differing only in
+        # bandwidth would share one compiled trial program
+        _fp["band_mhz"] = [float(cfg.meta.fcent_mhz),
+                           float(cfg.meta.bw_mhz)]
+        self._program_digest = hashlib.sha256(
+            json.dumps(_fp, sort_keys=True).encode()).hexdigest()
 
     # -- construction bridges ---------------------------------------------
 
@@ -407,9 +431,30 @@ class MonteCarloStudy:
 
     # -- compiled chunk programs ------------------------------------------
 
+    _PROGRAM_FIELDS = ("cfg", "priors", "param_names", "metric_names",
+                       "dm", "base_width", "noise_norm", "nharm",
+                       "_scenario", "_tau_ref_mhz", "_hist_ranges",
+                       "hist_bins")
+
+    def _program_context(self):
+        """A slim stand-in for ``self`` holding ONLY the fields the
+        trial program reads.  Registry-cached program closures live for
+        the process; capturing the full study would pin its Simulation
+        bridge, device buffers, and the per-instance program dict (a
+        reference cycle) in the shared store — the context carries just
+        the digest-covered statics, so a discarded study is collectable
+        the moment its caller drops it."""
+        ctx = object.__new__(type(self))
+        for name in self._PROGRAM_FIELDS:
+            setattr(ctx, name, getattr(self, name))
+        return ctx
+
     def _program(self, width):
         """One jitted sharded program per chunk width: trials -> metric
-        rows (sharded vmap) + in-graph histogram/min/max reduction."""
+        rows (sharded vmap) + in-graph histogram/min/max reduction —
+        resolved through the shared program registry keyed by the
+        study's program digest (the per-instance dict stays as the
+        lock-free fast path)."""
         prog = self._programs.get(width)
         if prog is not None:
             return prog
@@ -420,10 +465,12 @@ class MonteCarloStudy:
         his = jnp.asarray([self._hist_ranges[m][1]
                            for m in self.metric_names], jnp.float32)
 
+        ctx = self._program_context()
+
         def _local(keys, idxs, profiles, freqs, chan_ids):
             return jax.vmap(
-                lambda k, i: self._trial_metrics(k, i, profiles, freqs,
-                                                 chan_ids)
+                lambda k, i: ctx._trial_metrics(k, i, profiles, freqs,
+                                                chan_ids)
             )(keys, idxs)
 
         # check_rep=False: the metric row REDUCES the channel axis, which
@@ -439,23 +486,32 @@ class MonteCarloStudy:
             check_rep=False,
         )
 
-        @jax.jit
-        def chunk_program(keys, idxs, count, profiles, freqs, chan_ids):
-            metrics = sharded(keys, idxs, profiles, freqs, chan_ids)
-            valid = jnp.arange(width) < count   # padded tail rows
-            w = valid.astype(jnp.int32)
-            cols = metrics.T
-            hist = jax.vmap(
-                lambda c, lo, hi: fixed_histogram(c, lo, hi, nbins,
-                                                  weights=w)
-            )(cols, los, his)
-            inf = jnp.float32(jnp.inf)
-            mn = jnp.min(jnp.where(valid[None, :], cols, inf), axis=1)
-            mx = jnp.max(jnp.where(valid[None, :], cols, -inf), axis=1)
-            return metrics, hist, mn, mx
+        def _build():
+            @jax.jit
+            def chunk_program(keys, idxs, count, profiles, freqs, chan_ids):
+                metrics = sharded(keys, idxs, profiles, freqs, chan_ids)
+                valid = jnp.arange(width) < count   # padded tail rows
+                w = valid.astype(jnp.int32)
+                cols = metrics.T
+                hist = jax.vmap(
+                    lambda c, lo, hi: fixed_histogram(c, lo, hi, nbins,
+                                                      weights=w)
+                )(cols, los, his)
+                inf = jnp.float32(jnp.inf)
+                mn = jnp.min(jnp.where(valid[None, :], cols, inf), axis=1)
+                mx = jnp.max(jnp.where(valid[None, :], cols, -inf), axis=1)
+                return metrics, hist, mn, mx
 
-        self._programs[width] = chunk_program
-        return chunk_program
+            return chunk_program
+
+        from ..runtime.programs import global_registry, trace_env_key
+
+        prog = global_registry().get_or_build(
+            ("mc_trial", self._program_digest, self.mesh, int(width),
+             trace_env_key()),
+            _build)
+        self._programs[width] = prog
+        return prog
 
     def _chunk_inputs(self, start, n_trials, width):
         """Keys + global indices for one chunk, placed with the trial
@@ -781,12 +837,18 @@ class MonteCarloStudy:
             return np.zeros((int(n_trials), 0), np.float32)
 
         if self._param_fn is None:
+            ctx = self._program_context()
+
             def one(k, i):
-                p = self._sample_params(k, i)
+                p = ctx._sample_params(k, i)
                 return jnp.stack([jnp.asarray(p[n], jnp.float32)
                                   for n in names])
 
-            self._param_fn = jax.jit(jax.vmap(one))
+            from ..runtime.programs import global_registry, trace_env_key
+
+            self._param_fn = global_registry().get_or_build(
+                ("mc_params", self._program_digest, trace_env_key()),
+                lambda: jax.jit(jax.vmap(one)))
         _params = self._param_fn
         root = jax.random.key(self.seed)
         out = np.empty((int(n_trials), len(names)), np.float32)

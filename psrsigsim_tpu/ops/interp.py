@@ -151,20 +151,46 @@ def pchip_fit_np(x, y):  # psrlint: disable=PSR102,PSR104 (host reference varian
     Returns :class:`PchipCoeffs` whose slopes come from the scipy
     interpolant's derivative at the breakpoints — identical Fritsch-Carlson
     values, consumable by :func:`pchip_eval` on device.
-    """
+
+    scipy's ``_find_derivatives`` computes the weighted harmonic mean as
+    ``(w1/mk[:-1] + w2/mk[1:]) / (w1 + w2)`` and masks non-monotone /
+    zero-slope intervals AFTERWARDS, so near-zero secant slopes (flat
+    off-pulse regions of steep-spectrum portraits) overflow in the
+    intermediate divide and numpy emits a RuntimeWarning that scipy
+    itself then discards.  A warning in a reference-parity path can mask
+    a real divergence, so the benign intermediate is silenced HERE (this
+    call only) and replaced with the check that actually matters: every
+    returned slope must be finite, loudly."""
     from scipy.interpolate import PchipInterpolator
 
     x = np.asarray(x, dtype=np.float64)
     y = np.asarray(y, dtype=np.float64)
-    interp = PchipInterpolator(x, y, axis=-1)
-    slopes = interp.derivative()(x)  # (..., N), same layout as y
+    with np.errstate(over="ignore", divide="ignore", invalid="ignore"):
+        interp = PchipInterpolator(x, y, axis=-1)
+        slopes = interp.derivative()(x)  # (..., N), same layout as y
+    if not np.all(np.isfinite(slopes)):
+        raise FloatingPointError(
+            "scipy PCHIP produced non-finite derivative(s): the input "
+            "profile is degenerate (non-finite values, or duplicate "
+            "breakpoints) — this is a real divergence, not the benign "
+            "harmonic-mean overflow")
     return PchipCoeffs(x=x, y=y, d=slopes)
 
 
 def pchip_eval_np(coeffs, xq):  # psrlint: disable=PSR102,PSR104 (host reference variant)
-    """Host float64 PCHIP evaluation (scipy), matching :func:`pchip_eval`."""
+    """Host float64 PCHIP evaluation (scipy), matching :func:`pchip_eval`.
+    Same intermediate-overflow discipline as :func:`pchip_fit_np`: the
+    construction's benign divide is silenced, the OUTPUT is asserted
+    finite."""
     from scipy.interpolate import PchipInterpolator
 
     x, y, _ = coeffs
-    interp = PchipInterpolator(np.asarray(x), np.asarray(y), axis=-1)
-    return interp(np.asarray(xq, dtype=np.float64))
+    with np.errstate(over="ignore", divide="ignore", invalid="ignore"):
+        interp = PchipInterpolator(np.asarray(x), np.asarray(y), axis=-1)
+        out = interp(np.asarray(xq, dtype=np.float64))
+    if not np.all(np.isfinite(out)):
+        raise FloatingPointError(
+            "scipy PCHIP evaluation produced non-finite value(s) — "
+            "degenerate interpolant or query points, not the benign "
+            "construction overflow")
+    return out

@@ -21,7 +21,8 @@ from jax import lax
 __all__ = ["chi2_sample", "normal_sample", "chi2_draw_norm",
            "SEQ_RNG_BLOCK", "blocked_chan_chi2", "blocked_chan_normal",
            "sampler_backend", "chan_chi2_field", "chan_normal_field",
-           "flat_normal_field", "FLAT_TILE", "fixed_histogram"]
+           "flat_normal_field", "flat_chi2_field", "FLAT_TILE",
+           "fixed_histogram"]
 
 # Fixed span of global time samples per RNG key: ALL pipeline draws —
 # unsharded and sequence-sharded alike — are keyed by
@@ -298,6 +299,90 @@ def flat_normal_field(key, f0, length):
     if isinstance(off, int) and off == 0 and flat.shape[0] == length:
         return flat
     return lax.dynamic_slice(flat, (jnp.asarray(off, jnp.int32),), (length,))
+
+
+def flat_chi2_field(key, f0, length, df):
+    """Chi-squared draws from the FLAT whole-tile normal stream.
+
+    The SEARCH-mode pipeline's chi² fields are the largest draws in the
+    repo (two ~52M-sample fields per bench observation) and every one of
+    them routes through a NORMAL transform — df=1 is exactly ``z²``
+    (:func:`chi2_sample`'s df=1 identity) and large df is the
+    Wilson-Hilferty cube of a normal — so the whole field can come from
+    :func:`flat_normal_field`'s whole-tile stream (the trick that made
+    baseband 2.2× faster, docs/performance.md) with the chi² transform
+    applied elementwise in registers.  Because the transform is
+    elementwise, any span/shard slicing commutes with it: shard-count
+    invariance is inherited from the flat normal stream unchanged.
+
+    Callers map global (channel, sample) coordinates to flat offsets
+    (channel-major ``c * nsamp + t``) exactly as the baseband pipeline
+    maps its pol-major stream.  This selects a different REALIZATION of
+    the same distribution than the per-channel-keyed
+    :func:`chan_chi2_field` (like every backend/layout choice — never
+    different statistics).
+
+    Restrictions: a static ``df`` must be 1 or >= :data:`CHI2_WH_MIN_DF`
+    (the gamma rejection sampler cannot be expressed as one normal
+    transform); with ``PSS_EXACT_CHI2=1`` callers must keep the blocked
+    per-channel path so the exact-gamma escape hatch controls every
+    draw — :func:`flat_chi2_ok` is the staging-time guard for both.
+    """
+    z = flat_normal_field(key, f0, length)
+    try:
+        static_df = float(df)  # raises for traced values
+    except Exception:
+        static_df = None
+    if static_df == 1.0:
+        return z * z
+    if static_df is not None:
+        if static_df < CHI2_WH_MIN_DF:
+            raise ValueError(
+                f"flat_chi2_field needs df=1 or df >= {CHI2_WH_MIN_DF:.0f} "
+                f"(got {static_df}): small-df chi2 uses the gamma "
+                "rejection sampler, which has no flat-normal form — use "
+                "chan_chi2_field")
+        k = jnp.asarray(static_df, z.dtype)
+        c = 2.0 / (9.0 * k)
+        return jnp.maximum(k * (1.0 - c + z * jnp.sqrt(c)) ** 3, 0.0)
+    # traced df: the same df==1 / WH in-graph select as chi2_sample
+    k = jnp.asarray(df, z.dtype)
+    c = 2.0 / (9.0 * k)
+    wh = jnp.maximum(k * (1.0 - c + z * jnp.sqrt(c)) ** 3, 0.0)
+    return jnp.where(k == 1.0, z * z, wh)
+
+
+# flat offsets are carried as (possibly traced) int32 inside the jitted
+# pipelines (x64 is disabled); any consumer whose LARGEST global flat
+# offset would overflow must stay on the per-channel-keyed path, and the
+# check must use the same global bound on every shard so the realization
+# choice can never differ between sharded and unsharded programs
+FLAT_MAX_OFFSET = 2**31 - 1
+
+
+def flat_chi2_ok(df, span_end=None):
+    """True when :func:`flat_chi2_field` can legally produce ``df`` draws
+    under the current trace-time environment (see its restrictions).
+    Host-side staging helper: pipelines call it once per trace to pick
+    between the flat and the per-channel-keyed sampler.
+
+    ``span_end``: the consumer's largest global flat offset (e.g.
+    ``nchan * nsamp`` for a channel-major field) — offsets past
+    :data:`FLAT_MAX_OFFSET` would silently wrap in int32, so such
+    streams keep the per-channel path.  Callers MUST pass the GLOBAL
+    bound (not a shard-local one) so every shard picks the same
+    realization."""
+    import os
+
+    if os.environ.get("PSS_EXACT_CHI2"):
+        return False  # the exact-gamma hatch must control every draw
+    if span_end is not None and int(span_end) > FLAT_MAX_OFFSET:
+        return False
+    try:
+        static_df = float(df)
+    except Exception:
+        return True  # traced df: the in-graph select handles 1 vs WH
+    return static_df == 1.0 or static_df >= CHI2_WH_MIN_DF
 
 
 def fixed_histogram(x, lo, hi, nbins, weights=None):

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.quantize import subint_quantize, swap16
+from ..runtime.programs import global_registry, trace_env_key
 from ..simulate.pipeline import (
     build_fold_config,
     fold_pipeline,
@@ -219,14 +220,29 @@ class FoldEnsemble:
             + ((P(OBS_AXIS, None),) if scen is not None else ())
             + (P(CHAN_AXIS, None), P(CHAN_AXIS), P(CHAN_AXIS))
         )
-        self._run_sharded = jax.jit(
-            shard_map(
-                _local,
-                mesh=mesh,
-                in_specs=_in_specs,
-                out_specs=P(OBS_AXIS, CHAN_AXIS, None),
-            )
-        )
+        # program resolution goes through the repo-wide registry
+        # (runtime/programs.py): the key holds exactly the static
+        # geometry that shapes each compiled program (cfg, mesh,
+        # scenario stack) — profiles/DMs/norms/keys are traced inputs —
+        # so a second FoldEnsemble over the same geometry (a resumed
+        # export, a warm bench loop, a study bridge) reuses the SAME
+        # jitted callables instead of re-tracing three programs, and the
+        # registry's build counts make any duplicate work visible
+        # (bench.py's shared-registry gate pins builds == 1 per key).
+        # trace_env_key: the PSS_* trace-time hatches are part of what a
+        # program computes — flipping one must re-trace, not hit
+        _registry = global_registry()
+        _gkey = (cfg, mesh, scen, trace_env_key())
+        self._run_sharded = _registry.get_or_build(
+            ("ensemble_fold",) + _gkey,
+            lambda: jax.jit(
+                shard_map(
+                    _local,
+                    mesh=mesh,
+                    in_specs=_in_specs,
+                    out_specs=P(OBS_AXIS, CHAN_AXIS, None),
+                )
+            ))
 
         def _rfi_masks(args):
             # in-graph ground-truth RFI mask (B_loc, C_loc, nsub),
@@ -301,12 +317,16 @@ class FoldEnsemble:
                 P(OBS_AXIS, CHAN_AXIS),
             ) + ((P(OBS_AXIS, CHAN_AXIS, None),) if has_rfi else ()),
         )
-        self._run_sharded_quantized_packed = jax.jit(
-            shard_map(_local_quantized_packed, **_packed_specs)
-        )
-        self._run_sharded_quantized_packed_be = jax.jit(
-            shard_map(_local_quantized_packed_be, **_packed_specs)
-        )
+        # the export path's packed-quantized program family — previously
+        # a per-instance jit cache — resolves through the same registry
+        self._run_sharded_quantized_packed = _registry.get_or_build(
+            ("ensemble_quantized_packed", "little") + _gkey,
+            lambda: jax.jit(
+                shard_map(_local_quantized_packed, **_packed_specs)))
+        self._run_sharded_quantized_packed_be = _registry.get_or_build(
+            ("ensemble_quantized_packed", "big") + _gkey,
+            lambda: jax.jit(
+                shard_map(_local_quantized_packed_be, **_packed_specs)))
 
     @staticmethod
     def _validate_per_obs(n_obs, dms, noise_norms):
@@ -965,7 +985,9 @@ class MultiPulsarFoldEnsemble:
         return len(self._buckets)
 
     def _program(self, bkey, cfg, epochs):
-        """One compiled program per (bucket, epochs) combination."""
+        """One compiled program per (bucket, epochs) combination,
+        resolved through the shared registry (the per-instance dict is
+        kept as a lock-free fast path for the hot run() loop)."""
         cache_key = (bkey, epochs)
         if cache_key in self._compiled:
             return self._compiled[cache_key]
@@ -995,24 +1017,27 @@ class MultiPulsarFoldEnsemble:
                 keys, dms, norms, nfolds, draw_norms, dts, profiles, freqs
             )
 
-        prog = jax.jit(
-            shard_map(
-                _local,
-                mesh=mesh,
-                in_specs=(
-                    P(OBS_AXIS),                 # keys (P, E)
-                    P(OBS_AXIS),                 # dms
-                    P(OBS_AXIS),                 # noise norms
-                    P(OBS_AXIS),                 # nfolds
-                    P(OBS_AXIS),                 # draw norms
-                    P(OBS_AXIS),                 # dt_ms (per-pulsar spacing)
-                    P(OBS_AXIS, CHAN_AXIS, None),  # profiles
-                    P(OBS_AXIS, CHAN_AXIS),      # freqs
-                    P(CHAN_AXIS),                # chan ids
-                ),
-                out_specs=P(OBS_AXIS, None, CHAN_AXIS, None),
-            )
-        )
+        prog = global_registry().get_or_build(
+            ("hetero_fold", cfg, mesh, int(epochs), self.epoch_chunk,
+             trace_env_key()),
+            lambda: jax.jit(
+                shard_map(
+                    _local,
+                    mesh=mesh,
+                    in_specs=(
+                        P(OBS_AXIS),                 # keys (P, E)
+                        P(OBS_AXIS),                 # dms
+                        P(OBS_AXIS),                 # noise norms
+                        P(OBS_AXIS),                 # nfolds
+                        P(OBS_AXIS),                 # draw norms
+                        P(OBS_AXIS),                 # dt_ms (per-pulsar dt)
+                        P(OBS_AXIS, CHAN_AXIS, None),  # profiles
+                        P(OBS_AXIS, CHAN_AXIS),      # freqs
+                        P(CHAN_AXIS),                # chan ids
+                    ),
+                    out_specs=P(OBS_AXIS, None, CHAN_AXIS, None),
+                )
+            ))
         self._compiled[cache_key] = prog
         return prog
 

@@ -42,7 +42,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.shift import coherent_dedisperse, fourier_shift
 from ..ops.stats import (SEQ_RNG_BLOCK, blocked_chan_chi2,
                          blocked_chan_normal, chan_chi2_field,
-                         chan_normal_field, flat_normal_field)
+                         chan_normal_field, flat_chi2_field, flat_chi2_ok,
+                         flat_normal_field)
 from ..simulate.pipeline import (_dispersion_delays, _null_mask_at,
                                  _null_mask_row)
 from ..utils.rng import stage_key
@@ -105,6 +106,25 @@ def _search_seq_body(cfg, n, L):
     # t0 = shard * L: block-aligned for every shard when L divides by the
     # RNG block, which drops the one-block overdraw per edge
     aligned = (L % SEQ_RNG_BLOCK == 0)
+    # the main pulse/noise fields come from the FLAT whole-tile chi2
+    # stream (simulate.pipeline._search_chi2: channel-major flat offsets
+    # c*nsamp + t), so a time shard draws one flat span per channel —
+    # the SAME global stream single_pipeline draws, sample-for-sample.
+    # Resolved at trace time exactly like the unsharded pipeline
+    # (including the GLOBAL nchan*nsamp int32-offset bound — every shard
+    # count evaluates the same predicate) so the two can never disagree
+    # on the realization
+    _span_end = int(nchan) * int(cfg.nsamp)
+    flat_pulse = flat_chi2_ok(1.0, span_end=_span_end)
+    flat_noise = flat_chi2_ok(cfg.noise_df, span_end=_span_end)
+
+    def _search_chi2_span(key, chan_ids, df, t0, use_flat):
+        if not use_flat:
+            return chan_chi2_field(key, chan_ids, df, t0, L,
+                                   aligned=aligned)
+        return jax.vmap(
+            lambda c: flat_chi2_field(key, c * cfg.nsamp + t0, L, df)
+        )(chan_ids)
 
     def body(key, dm, noise_norm, profiles, extra_delays_ms):
         # profiles (Nchan, nph) replicated; this shard owns global time
@@ -124,8 +144,8 @@ def _search_seq_body(cfg, n, L):
         else:
             prof = profiles
         block = jnp.take(prof, gsamp % cfg.nph, axis=1)
-        block = block * chan_chi2_field(kp, chan_ids, 1.0, t0, L,
-                                        aligned=aligned) * cfg.draw_norm
+        block = block * _search_chi2_span(kp, chan_ids, 1.0, t0,
+                                          flat_pulse) * cfg.draw_norm
 
         # nulling: shared global-index mask (one source of truth with
         # single_pipeline); same keys on every shard
@@ -162,8 +182,8 @@ def _search_seq_body(cfg, n, L):
             block = lax.all_to_all(gathered, SEQ_AXIS, 1, 0, tiled=True)
 
         # radiometer noise (chi2 df=1 in search mode), time-sharded
-        noise = chan_chi2_field(kn, chan_ids, cfg.noise_df, t0, L,
-                                aligned=aligned)
+        noise = _search_chi2_span(kn, chan_ids, cfg.noise_df, t0,
+                                  flat_noise)
         return block + noise * noise_norm
 
     return body

@@ -16,9 +16,16 @@
 - :mod:`~psrsigsim_tpu.runtime.telemetry` — per-stage timers for the
   streaming export pipeline (dispatch/fetch/encode/write, queue depths,
   bytes), accumulated into the export manifest and the bench report.
+- :mod:`~psrsigsim_tpu.runtime.programs` — the shared program registry:
+  one geometry-keyed compiled-artifact store (build counts, compile
+  telemetry, persistent-compilation-cache wiring) that the ensemble,
+  Monte-Carlo, export, and serving program families all resolve through
+  instead of holding private jit caches.
 """
 
 from .faults import FaultPlan
+from .programs import ProgramRegistry, enable_compilation_cache, \
+    global_registry
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
 from .supervisor import (ProcessSupervisor, RunResult, RunSupervisor,
                          supervised_export)
@@ -26,10 +33,13 @@ from .telemetry import StageTimers
 
 __all__ = [
     "FaultPlan",
+    "ProgramRegistry",
     "RetryPolicy",
     "RetriesExhausted",
     "StageTimers",
     "call_with_retry",
+    "enable_compilation_cache",
+    "global_registry",
     "ProcessSupervisor",
     "RunResult",
     "RunSupervisor",

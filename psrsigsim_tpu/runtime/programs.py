@@ -1,0 +1,248 @@
+"""One program registry for the whole repo: geometry-keyed compiled
+artifacts with build/compile-count telemetry.
+
+Before this module, four subsystems each rolled their own program
+caching: the serving layer AOT-compiled per (geometry, width) in
+``serve/programs.py`` (the right shape — warmup, retrace guards,
+persistent-cache wiring), while ensemble chunk programs, Monte-Carlo
+trial programs, and the export path's packed-quantized programs each
+held private ``jit`` caches keyed by Python object identity — so two
+:class:`~psrsigsim_tpu.parallel.FoldEnsemble` objects over the SAME
+geometry re-traced (and on first dispatch re-compiled) every program,
+and nothing counted it.  This registry is the shared resolution point:
+
+* ``get_or_build(key, builder)`` — one compiled/jitted artifact per
+  hashable key, built exactly once per process (thread-safe, losers of a
+  concurrent build race keep the winner's artifact), with per-key build
+  counts and cumulative build seconds.
+* :func:`global_registry` — the process-wide instance the ensemble, MC,
+  and export program families resolve through (the serving layer's
+  :class:`psrsigsim_tpu.serve.ProgramRegistry` composes a private
+  instance so its per-service single-compile guard keeps meaning, same
+  class, same telemetry shape).
+* :func:`enable_compilation_cache` — JAX persistent-compilation-cache
+  wiring (moved here from ``serve/programs.py``; serve re-exports), so
+  ANY consumer can bound restart cold-start with an on-disk artifact
+  store shared across processes and replicas.
+* Telemetry: :meth:`ProgramRegistry.attach_timers` points the registry
+  at a :class:`~psrsigsim_tpu.runtime.telemetry.StageTimers`; every
+  build then lands one ``"compile"``-stage sample there, and
+  :meth:`snapshot` is folded into export manifests / bench JSON so
+  every run records how many programs it actually built.
+
+Keys are ordinary hashable tuples.  By convention the first element
+names the program family (``"ensemble_fold"``, ``"mc_trial"``,
+``"serve_bucket"``, ...) and the rest is the geometry that shapes the
+compiled program — static configs, mesh, scenario stack, width — and
+NOTHING that is merely traced (profiles, DMs, keys), so sharing is
+correct by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ProgramRegistry", "global_registry", "enable_compilation_cache",
+           "trace_env_key"]
+
+
+def trace_env_key():
+    """The trace-time environment knobs that change what a compiled
+    program COMPUTES (ops/stats.py reads them while tracing): the
+    sampler backend selector, the exact-chi2 escape hatch, and the
+    exact-shift escape hatch.  Every registry key for a program that
+    draws random fields must include this tuple — per-instance jit
+    caches died with their instances, so a flipped env var used to get
+    a fresh trace for free; the process-global registry must key on it
+    explicitly or it would silently serve programs traced under the old
+    settings.
+
+    The key is captured at CONSTRUCTION time while jit traces lazily at
+    first dispatch — so the documented contract for these variables
+    ("set them before building pipelines", README configuration table)
+    is load-bearing: flipping one between constructing a pipeline and
+    first running it is undefined (pre-registry builds traced whatever
+    was set at first dispatch; registry builds honor what was set at
+    construction)."""
+    import os
+
+    return (os.environ.get("PSS_SAMPLER", "auto"),
+            bool(os.environ.get("PSS_EXACT_CHI2")),
+            bool(os.environ.get("PSS_EXACT_SHIFT")))
+
+
+def enable_compilation_cache(path):
+    """Point JAX's persistent compilation cache at ``path`` (created by
+    JAX on first write).  Returns True when the option stuck — older/newer
+    JAX spellings are tried in order and absence is non-fatal (callers
+    still work; restarts just pay compiles again)."""
+    import jax
+
+    ok = False
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        ok = True
+    except AttributeError:  # pragma: no cover - config name drift
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.set_cache_dir(str(path))
+            ok = True
+        except Exception:
+            return False
+    # cache even instant compiles: the programs are small on CPU test
+    # geometries but the REAL cost this exists for is TPU warmup
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # noqa: BLE001 - option names drift across jax
+            pass
+    return ok
+
+
+class ProgramRegistry:
+    """Hashable-key -> compiled/jitted program, built once per process.
+
+    ``name`` labels the instance in snapshots (the global instance is
+    ``"global"``; the serving layer names its per-service instances
+    ``"serve"``).  For AOT consumers (serve) a build IS an XLA compile;
+    for ``jax.jit`` consumers (ensemble/MC/export) a build constructs
+    the traced callable once and XLA compiles lazily per input shape —
+    either way, build count 1 per key is the no-duplicate-work contract
+    the gates pin.
+    """
+
+    #: default artifact cap — far above any real process's distinct
+    #: geometry count, small enough that a parameter scan constructing
+    #: thousands of distinct studies cannot grow memory without bound
+    #: (per-instance caches used to die with their instances; a
+    #: process-global store needs an explicit bound)
+    DEFAULT_MAX_PROGRAMS = 256
+
+    def __init__(self, name="global", compile_cache_dir=None, timers=None,
+                 max_programs=None):
+        from collections import OrderedDict
+
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._programs = OrderedDict()  # key -> artifact (LRU order)
+        self._max_programs = int(max_programs
+                                 if max_programs is not None
+                                 else self.DEFAULT_MAX_PROGRAMS)
+        self._builds = {}         # key -> build count (1 unless evicted)
+        self._hits = {}           # key -> get_or_build calls served cached
+        self._build_seconds = 0.0
+        self._evictions = 0
+        self._timers = timers
+        self.cache_enabled = (
+            enable_compilation_cache(compile_cache_dir)
+            if compile_cache_dir else False)
+
+    # -- resolution --------------------------------------------------------
+
+    def get_or_build(self, key, builder):
+        """The program for ``key``, building it with ``builder()`` on
+        first use.  Concurrent builders of the same key may both run;
+        exactly one artifact is kept (both are valid — the counts record
+        what actually happened, which is what the single-build gates
+        check after warmup).
+
+        The store is an LRU bounded at ``max_programs`` artifacts:
+        consumers keep their own references, so eviction only costs a
+        rebuild if a long-gone geometry returns (and bumps that key's
+        build count past 1 — the single-build gates run at warmup
+        scales, far under the cap)."""
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self._programs.move_to_end(key)
+                self._hits[key] = self._hits.get(key, 0) + 1
+                return prog
+        t0 = time.perf_counter()
+        built = builder()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._builds[key] = self._builds.get(key, 0) + 1
+            self._build_seconds += dt
+            prog = self._programs.setdefault(key, built)
+            self._programs.move_to_end(key)
+            while len(self._programs) > self._max_programs:
+                self._programs.popitem(last=False)
+                self._evictions += 1
+            timers = self._timers
+        if timers is not None:
+            timers.add("compile", dt)
+            timers.count("program_builds")
+        return prog
+
+    def peek(self, key):
+        """The cached program or None — never builds."""
+        with self._lock:
+            return self._programs.get(key)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def attach_timers(self, timers):
+        """Route build telemetry into ``timers`` (a
+        :class:`~psrsigsim_tpu.runtime.telemetry.StageTimers`): each
+        subsequent build adds one ``"compile"`` stage sample and bumps
+        the ``program_builds`` counter.  Last attach wins; pass None to
+        detach."""
+        with self._lock:
+            self._timers = timers
+
+    def build_counts(self):
+        with self._lock:
+            return dict(self._builds)
+
+    def hit_counts(self):
+        with self._lock:
+            return dict(self._hits)
+
+    def assert_single_build(self, family=None):
+        """The shared-registry no-duplicate-work guard: every key (or
+        every key of one ``family`` prefix) was built exactly once."""
+        bad = {k: c for k, c in self.build_counts().items()
+               if c != 1 and (family is None or k[0] == family)}
+        if bad:
+            raise AssertionError(
+                f"registry {self.name!r}: programs built more than once: "
+                f"{bad}")
+
+    def snapshot(self):
+        """JSON-ready summary (family-aggregated: raw keys hold live
+        config objects that do not belong in a manifest)."""
+        with self._lock:
+            fams = {}
+            for k, c in self._builds.items():
+                fam = k[0] if isinstance(k, tuple) and k else str(k)
+                fams[str(fam)] = fams.get(str(fam), 0) + c
+            hits = {}
+            for k, c in self._hits.items():
+                fam = k[0] if isinstance(k, tuple) and k else str(k)
+                hits[str(fam)] = hits.get(str(fam), 0) + c
+            return {
+                "registry": self.name,
+                "programs": len(self._programs),
+                "builds_total": int(sum(self._builds.values())),
+                "build_seconds": round(self._build_seconds, 6),
+                "evictions": self._evictions,
+                "builds_by_family": dict(sorted(fams.items())),
+                "hits_by_family": dict(sorted(hits.items())),
+            }
+
+
+# the process-wide instance: ensemble / MC / export program families all
+# resolve here, so constructing a second FoldEnsemble (or study, or
+# exporter) over an already-seen geometry is a registry hit, not a
+# re-trace.  Memory is bounded by the LRU cap (DEFAULT_MAX_PROGRAMS) —
+# a parameter scan over thousands of distinct geometries recycles the
+# oldest artifacts instead of growing forever.
+_GLOBAL = ProgramRegistry("global")
+
+
+def global_registry():
+    """The process-wide shared :class:`ProgramRegistry`."""
+    return _GLOBAL

@@ -111,6 +111,7 @@ class StageTimers:
         self._hist = {k: [0] * LATENCY_NBINS for k in self._stages}
         self._bytes_fetched = 0
         self._depths = {}  # queue name -> [sum, samples, max]
+        self._counters = {}  # name -> int (program builds, cache events...)
 
     def add(self, stage, seconds, nbytes=0):
         """Accumulate ``seconds`` of busy time against ``stage`` (one of
@@ -144,6 +145,19 @@ class StageTimers:
         with self._lock:
             return _hist_percentile(self._hist.get(stage, ()), q)
 
+    def count(self, name, n=1):
+        """Bump a named event counter (e.g. ``program_builds`` from the
+        shared program registry): counters ride every snapshot as
+        ``<name>_count``, so manifests and bench JSON record how many
+        compiles/builds a run actually paid — the compile-count
+        telemetry of the shared registry (ROADMAP item 5)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def counter(self, name):
+        with self._lock:
+            return self._counters.get(name, 0)
+
     def depth(self, name, value):
         """Record one bounded-queue depth sample (e.g. the fetched-chunk
         queue right before the consumer pops it: 0 means the consumer
@@ -175,6 +189,8 @@ class StageTimers:
                             _hist_percentile(self._hist[k], q), 6)
             out["bytes_fetched"] = self._bytes_fetched
             out["wall_s"] = round(time.perf_counter() - self._t0, 6)
+            for name, n in sorted(self._counters.items()):
+                out[f"{name}_count"] = n
             for name, (tot, n, mx) in sorted(self._depths.items()):
                 out[f"{name}_depth_max"] = mx
                 out[f"{name}_depth_mean"] = round(tot / max(n, 1), 3)

@@ -358,6 +358,26 @@ class ResultCache:
         append) and is broken under the flock."""
         path = self._claim_path(h)
         while True:
+            # check the journal BEFORE attempting the claim, every
+            # iteration: once a commit exists, taking a claim is never
+            # correct.  (Previously a waiter that watched the winner's
+            # marker vanish re-claimed without this check, becoming a
+            # duplicate writer whose LIVE marker a third waiter — seeing
+            # the committed record — would "clean up" as an orphan,
+            # letting a fourth writer run concurrently: two same-PID
+            # threads then raced on one artifact tmp name.)
+            with self._lock:
+                self._refresh_locked()
+                rec = self._index.get(h)
+            if rec is not None:
+                # committed; a marker here can only be an orphan from a
+                # writer killed after its journal append (live writers
+                # hold their claim from pre-commit to post-append, and
+                # with the check-first discipline none starts after the
+                # commit) — clean it up
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                return rec
             try:
                 fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             except FileExistsError:
@@ -367,19 +387,10 @@ class ResultCache:
                 os.close(fd)
                 return None
             # lost the race: wait for the winner's journal record
-            with self._lock:
-                self._refresh_locked()
-                rec = self._index.get(h)
-            if rec is not None:
-                # committed; the marker may be an orphan from a writer
-                # killed after its journal append — clean it up
-                with contextlib.suppress(OSError):
-                    os.unlink(path)
-                return rec
             try:
                 age = time.time() - os.stat(path).st_mtime
             except FileNotFoundError:
-                continue  # winner finished or died; retry the claim
+                continue  # winner finished or died; loop re-checks first
             if age > self.claim_timeout_s:
                 with self._lock, self._flocked():
                     self._refresh_locked()
@@ -423,7 +434,10 @@ class ResultCache:
             # artifact first (temp + fsync + atomic rename), journal
             # second: an artifact is durable before it is indexable
             path = self._artifact_path(h)
-            tmp = f"{path}.{os.getpid()}.tmp"
+            # pid + thread id: the tmp name must be unique across the
+            # PROCESS's threads too (N in-process caches over one dir is
+            # the fleet test topology), belt-and-braces under the claim
+            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
             with open(tmp, "wb") as f:
                 f.write(payload)
                 f.flush()

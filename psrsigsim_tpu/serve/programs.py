@@ -17,6 +17,15 @@ registry therefore:
   so a restarted server's warmup is a disk read instead of a recompile —
   bounded cold-start.
 
+Storage and compile counting live in the repo-wide
+:class:`psrsigsim_tpu.runtime.ProgramRegistry`
+(``runtime/programs.py``) — the same resolution machinery the ensemble,
+Monte-Carlo, and export program families use — composed here as a
+PRIVATE instance per service so the per-replica single-compile guard
+keeps its meaning (a second service in the process must prove its own
+warmup, not inherit another's).  ``enable_compilation_cache`` is
+re-exported from the shared module.
+
 Widths are the powers the batcher rounds batches up to (padded rows are
 replicas of row 0 and are trimmed after execution); ``bucket_width``
 picks the smallest admitted width that fits.
@@ -28,39 +37,14 @@ import threading
 
 import numpy as np
 
+from ..runtime.programs import ProgramRegistry as _SharedRegistry
+from ..runtime.programs import enable_compilation_cache
+
 __all__ = ["ProgramRegistry", "DEFAULT_WIDTHS", "enable_compilation_cache"]
 
 DEFAULT_WIDTHS = (1, 8, 32)
 
-
-def enable_compilation_cache(path):
-    """Point JAX's persistent compilation cache at ``path`` (created by
-    JAX on first write).  Returns True when the option stuck — older/newer
-    JAX spellings are tried in order and absence is non-fatal (serving
-    still works; restarts just pay compiles again)."""
-    import jax
-
-    ok = False
-    try:
-        jax.config.update("jax_compilation_cache_dir", str(path))
-        ok = True
-    except AttributeError:  # pragma: no cover - config name drift
-        try:
-            from jax.experimental.compilation_cache import (
-                compilation_cache as _cc)
-            _cc.set_cache_dir(str(path))
-            ok = True
-        except Exception:
-            return False
-    # cache even instant compiles: the serving programs are small on CPU
-    # test geometries but the REAL cost this exists for is TPU warmup
-    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
-                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
-        try:
-            jax.config.update(opt, val)
-        except Exception:  # noqa: BLE001 - option names drift across jax
-            pass
-    return ok
+_FAMILY = "serve_bucket"
 
 
 class ProgramRegistry:
@@ -78,13 +62,14 @@ class ProgramRegistry:
         self._lock = threading.Lock()
         self._geoms = {}          # geom hash -> (cfg, profiles, noise_norm)
         self._stacks = {}         # geom hash -> ScenarioStack or None
-        self._programs = {}       # (geom hash, width) -> compiled executable
-        self._compile_counts = {}  # (geom hash, width) -> int
+        self._store = _SharedRegistry(
+            "serve", compile_cache_dir=compile_cache_dir)
         self._calls = {}          # (geom hash, width) -> executions
         self.device_calls = 0
-        self.cache_enabled = (
-            enable_compilation_cache(compile_cache_dir)
-            if compile_cache_dir else False)
+
+    @property
+    def cache_enabled(self):
+        return self._store.cache_enabled
 
     # -- geometry staging --------------------------------------------------
 
@@ -143,28 +128,24 @@ class ProgramRegistry:
     def program(self, geom_hash, width):
         """The compiled executable for (geometry, width); AOT-compiles on
         first use (warmup makes that never the serving path) and counts
-        every compile for the retrace guard."""
-        key = (geom_hash, int(width))
+        every compile for the retrace guard — resolution and counting go
+        through the shared runtime registry."""
         with self._lock:
-            prog = self._programs.get(key)
-            if prog is not None:
-                return prog
             cfg, profiles, _ = self._geoms[geom_hash]
             stack = self._stacks[geom_hash]
-        import jax
 
-        from ..parallel.ensemble import build_width_bucket_fn
+        def _build():
+            import jax
 
-        fn = build_width_bucket_fn(cfg, profiles, scenario=stack)
-        lowered = jax.jit(fn).lower(
-            *self._example_inputs(int(width), stack))
-        compiled = lowered.compile()
-        with self._lock:
-            # a concurrent compile of the same key keeps the first one
-            # (both are valid; counts record what actually happened)
-            self._compile_counts[key] = self._compile_counts.get(key, 0) + 1
-            prog = self._programs.setdefault(key, compiled)
-        return prog
+            from ..parallel.ensemble import build_width_bucket_fn
+
+            fn = build_width_bucket_fn(cfg, profiles, scenario=stack)
+            lowered = jax.jit(fn).lower(
+                *self._example_inputs(int(width), stack))
+            return lowered.compile()
+
+        return self._store.get_or_build(
+            (_FAMILY, geom_hash, int(width)), _build)
 
     def execute(self, geom_hash, width, keys, dms, norms, null_fracs,
                 sc=None):
@@ -187,8 +168,8 @@ class ProgramRegistry:
     # -- introspection / guards -------------------------------------------
 
     def compile_counts(self):
-        with self._lock:
-            return dict(self._compile_counts)
+        return {(g, w): c
+                for (_, g, w), c in self._store.build_counts().items()}
 
     def call_counts(self):
         with self._lock:
@@ -206,16 +187,19 @@ class ProgramRegistry:
 
     def stats(self):
         """JSON-ready summary for ``/metrics``: per-bucket execution
-        counts keyed ``geomprefix/width``, compile counts, device calls."""
+        counts keyed ``geomprefix/width``, compile counts, device calls,
+        and the shared-store build snapshot."""
+        counts = self.compile_counts()
         with self._lock:
             return {
                 "device_calls": self.device_calls,
                 "geometries": len(self._geoms),
-                "programs": len(self._programs),
+                "programs": len(counts),
                 "compile_counts": {
                     f"{g[:12]}/w{w}": c
-                    for (g, w), c in sorted(self._compile_counts.items())},
+                    for (g, w), c in sorted(counts.items())},
                 "bucket_calls": {
                     f"{g[:12]}/w{w}": c
                     for (g, w), c in sorted(self._calls.items())},
+                "registry": self._store.snapshot(),
             }

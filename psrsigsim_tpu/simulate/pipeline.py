@@ -26,6 +26,7 @@ import numpy as np
 from ..ops.shift import (coherent_dedisperse, coherent_dedisperse_os,
                          fourier_shift, plan_dedisperse_os)
 from ..ops.stats import (chan_chi2_field, chan_normal_field,
+                         flat_chi2_field, flat_chi2_ok,
                          flat_normal_field)
 from ..signal.state import SignalMeta
 from ..utils.constants import DM_K_MS_MHZ2
@@ -106,6 +107,31 @@ def _chan_chi2(key, chan_ids, df, nsamp):
     streams.  Dispatches to the Pallas hardware sampler on TPU
     (ops/rng_pallas.py) or the blocked threefry draws (ops/stats.py)."""
     return chan_chi2_field(key, chan_ids, df, 0, nsamp, aligned=True)
+
+
+def _search_chi2(key, chan_ids, df, nsamp, nchan_global=None):
+    """SEARCH-mode chi2 field draws from the FLAT whole-tile stream
+    (``ops/stats.flat_chi2_field`` — the baseband 2.2x whole-tile trick
+    applied to the two ~52M-sample SEARCH fields, ROADMAP item 3):
+    global flat offsets are channel-major ``c * nsamp + t``, so a
+    contiguous channel slab over the full time axis is ONE flat span and
+    a time shard is one span per channel (parallel/seqshard.py draws
+    those exact spans — sharded == unsharded sample-for-sample).
+
+    A different REALIZATION of the same statistics than the fold
+    pipeline's per-channel-keyed draws, like any backend choice; under
+    ``PSS_EXACT_CHI2=1`` (or a small static df, or a stream whose
+    GLOBAL flat extent ``nchan * nsamp`` would overflow the traced
+    int32 offsets) the per-channel path is kept — the guard uses the
+    global extent on purpose, so a channel shard and the unsharded
+    program always agree on which realization they draw."""
+    nc = int(chan_ids.shape[0])
+    span_end = int(nchan_global if nchan_global is not None
+                   else nc) * int(nsamp)
+    if not flat_chi2_ok(df, span_end=span_end):
+        return chan_chi2_field(key, chan_ids, df, 0, nsamp, aligned=True)
+    f0 = chan_ids[0] * nsamp
+    return flat_chi2_field(key, f0, nc * nsamp, df).reshape(nc, nsamp)
 
 
 def _dispersion_delays(dm, freqs, extra_delays_ms):
@@ -523,7 +549,8 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
     else:
         block = _tile_periodic(profiles, nsamp)
 
-    block = block * _chan_chi2(kp, chan_ids, 1.0, nsamp) * cfg.draw_norm
+    block = block * _search_chi2(kp, chan_ids, 1.0, nsamp,
+                                 cfg.meta.nchan) * cfg.draw_norm
 
     # pulse nulling (reference: pulsar.py:246-333) — static mask arithmetic,
     # no boolean indexing.  Same keys for every channel shard -> both the
@@ -558,7 +585,8 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
         block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
 
     # radiometer noise, chi2 df=1 in search mode (receiver.py:160-164)
-    return block + _chan_chi2(kn, chan_ids, cfg.noise_df, nsamp) * noise_norm
+    return block + _search_chi2(kn, chan_ids, cfg.noise_df, nsamp,
+                                cfg.meta.nchan) * noise_norm
 
 
 def build_single_config(signal, pulsar, telescope, system, Tsys=None,

@@ -60,6 +60,10 @@ def main(argv=None):
     ap.add_argument("--writers", type=int, default=1)
     ap.add_argument("--obs-per-file", type=int, default=1)
     ap.add_argument("--pipeline-depth", type=int, default=2)
+    ap.add_argument("--hetero-run-len", type=int, default=0,
+                    help="per-observation DMs in runs of this length "
+                         "(dm = 10 + 5 * (i // run_len)) — the per-pulsar "
+                         "grouped packed layout; 0 = no per-obs DMs")
     args = ap.parse_args(argv)
 
     import jax
@@ -78,9 +82,16 @@ def main(argv=None):
     sim = Simulation(psrdict=SIM_CONFIG)
     sim.init_all()
     ens = sim.to_ensemble()
+    dms = None
+    if args.hetero_run_len > 0:
+        # deterministic pulsar-major DM runs: identical across the
+        # killed run and its resume, so grouping (and bytes) reproduce
+        import numpy as np
+
+        dms = 10.0 + 5.0 * (np.arange(args.n_obs) // args.hetero_run_len)
     res = supervised_export(
         ens, args.n_obs, args.out_dir, TEMPLATE, ens.pulsar, seed=SEED,
-        chunk_size=args.chunk_size, writers=args.writers,
+        chunk_size=args.chunk_size, writers=args.writers, dms=dms,
         obs_per_file=args.obs_per_file, faults=plan,
         pipeline_depth=args.pipeline_depth,
         resume="verify" if args.resume_mode == "verify" else True)

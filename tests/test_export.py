@@ -193,12 +193,6 @@ class TestPackedExport:
         for fa, fb in zip(pa, pb):
             assert open(fa, "rb").read() == open(fb, "rb").read(), fa
 
-    def test_packed_rejects_per_obs_dms(self, ens, tmp_path):
-        with pytest.raises(ValueError, match="obs_per_file"):
-            export_ensemble_psrfits(
-                ens, 4, str(tmp_path / "x"), TEMPLATE, ens.pulsar,
-                dms=np.ones(4, np.float32), obs_per_file=2)
-
     def test_packed_shell_not_mutated(self, ens, tmp_path):
         sig = ens.signal_shell()
         before = (sig.nsub, sig.nsamp, float(sig.tobs.to("s").value))
@@ -206,6 +200,140 @@ class TestPackedExport:
                                 ens.pulsar, seed=10, obs_per_file=4)
         assert (sig.nsub, sig.nsamp,
                 float(sig.tobs.to("s").value)) == before
+
+
+class TestHeteroPackedExport:
+    """Per-pulsar grouped packed export: ``obs_per_file > 1`` WITH
+    per-observation DMs — groups cut at every DM change, one source (one
+    CHAN_DM/DM header) per file, the layout that unlocks the
+    heterogeneous multi-pulsar workload for packed files."""
+
+    # pulsar-major order: runs of equal DM, incl. a repeated value in a
+    # NON-adjacent run (must still split) and a short tail run
+    DMS = np.asarray([5.0, 5.0, 5.0, 5.0, 25.0, 25.0, 25.0, 5.0],
+                     np.float64)
+
+    def test_grouped_spans_and_headers(self, ens, tmp_path):
+        out = str(tmp_path / "het")
+        paths = export_ensemble_psrfits(ens, 8, out, TEMPLATE, ens.pulsar,
+                                        seed=20, chunk_size=3,
+                                        dms=self.DMS, obs_per_file=2)
+        # runs [0,4) [4,7) [7,8) at opf=2 -> spans (0,2)(2,4)(4,6)(6,7)(7,8)
+        spans = [(0, 1), (2, 3), (4, 5), (6, 6), (7, 7)]
+        assert [os.path.basename(p) for p in paths] == [
+            f"obs_{a:05d}-{b:05d}.fits" for a, b in spans]
+        nsub = ens.cfg.nsub
+        for p, (a, b) in zip(paths, spans):
+            sub = FitsFile.read(p)["SUBINT"]
+            assert sub.data["DATA"].shape[0] == (b - a + 1) * nsub
+            # one source per file: the group's (single) DM in the header
+            assert sub.read_header()["DM"] == pytest.approx(
+                float(self.DMS[a]))
+
+    def test_hetero_packed_bytes_equal_per_file(self, ens, tmp_path):
+        """Grouping changes file layout only: every observation's rows
+        are bit-identical to the per-file export of the same seed+dms,
+        and the per-group DM headers match the per-file ones."""
+        a = str(tmp_path / "single")
+        b = str(tmp_path / "packed")
+        pa = export_ensemble_psrfits(ens, 8, a, TEMPLATE, ens.pulsar,
+                                     seed=21, chunk_size=3, dms=self.DMS)
+        pb = export_ensemble_psrfits(ens, 8, b, TEMPLATE, ens.pulsar,
+                                     seed=21, chunk_size=3, dms=self.DMS,
+                                     obs_per_file=2)
+        from psrsigsim_tpu.io.export import _GroupPacker
+
+        packer = _GroupPacker(8, 2, dms=self.DMS)
+        nsub = ens.cfg.nsub
+        for i in range(8):
+            g = packer.group_of(i)
+            first, _ = packer.group_span(g)
+            sub_s = FitsFile.read(pa[i])["SUBINT"]
+            sub_p = FitsFile.read(pb[g])["SUBINT"]
+            sl = slice((i - first) * nsub, (i - first + 1) * nsub)
+            for col in ("DATA", "DAT_SCL", "DAT_OFFS"):
+                assert np.array_equal(sub_s.data[col],
+                                      sub_p.data[col][sl]), (i, col)
+            assert sub_s.read_header()["DM"] == sub_p.read_header()["DM"]
+
+    def test_hetero_packed_resume_byte_identical(self, ens, tmp_path):
+        """A deleted mid-run group file regenerates byte-identically on
+        resume — the DM-run grouping is a pure function of the
+        fingerprinted (n_obs, obs_per_file, dms), so a resumed export
+        regroups identically; the regenerated file goes through the full
+        assembly (fresh prototype) and must equal the fast-written
+        original, pinning fast == full for DM-patched prototypes."""
+        out = str(tmp_path / "hres")
+        paths = export_ensemble_psrfits(ens, 8, out, TEMPLATE, ens.pulsar,
+                                        seed=22, chunk_size=4,
+                                        dms=self.DMS, obs_per_file=2)
+        blobs = [open(p, "rb").read() for p in paths]
+        os.unlink(paths[1])   # fast-written (second file of the dm=5 run)
+        os.unlink(paths[3])
+        keep0 = os.path.getmtime(paths[0])
+        again = export_ensemble_psrfits(ens, 8, out, TEMPLATE, ens.pulsar,
+                                        seed=22, chunk_size=4,
+                                        dms=self.DMS, obs_per_file=2)
+        assert again == paths
+        assert os.path.getmtime(paths[0]) == keep0
+        for p, blob in zip(paths, blobs):
+            assert open(p, "rb").read() == blob, p
+
+    def test_hetero_packed_pool_matches_serial(self, ens, tmp_path):
+        a = str(tmp_path / "ser")
+        b = str(tmp_path / "par")
+        pa = export_ensemble_psrfits(ens, 8, a, TEMPLATE, ens.pulsar,
+                                     seed=23, chunk_size=4, dms=self.DMS,
+                                     obs_per_file=2, writers=1)
+        pb = export_ensemble_psrfits(ens, 8, b, TEMPLATE, ens.pulsar,
+                                     seed=23, chunk_size=4, dms=self.DMS,
+                                     obs_per_file=2, writers=2)
+        for fa, fb in zip(pa, pb):
+            assert open(fa, "rb").read() == open(fb, "rb").read(), fa
+
+    def test_all_distinct_dms_degenerate_to_singletons(self, ens, tmp_path):
+        dms = np.asarray([3.0, 7.0, 11.0], np.float64)
+        out = str(tmp_path / "dist")
+        paths = export_ensemble_psrfits(ens, 3, out, TEMPLATE, ens.pulsar,
+                                        seed=24, dms=dms, obs_per_file=4)
+        assert len(paths) == 3
+        for p, dm in zip(paths, dms):
+            sub = FitsFile.read(p)["SUBINT"]
+            assert sub.data["DATA"].shape[0] == ens.cfg.nsub
+            assert sub.read_header()["DM"] == pytest.approx(float(dm))
+
+    def test_proto_cache_eviction_stays_byte_identical(self, ens, tmp_path):
+        """With a 1-entry prototype LRU every (shape, DM) revisit
+        re-primes through the full assembly — bytes must not change."""
+        import jax
+
+        from psrsigsim_tpu.io.export import _FastObsWriter
+        from psrsigsim_tpu.utils import make_par
+
+        tmpl = FitsFile.read(TEMPLATE)
+        data, scl, offs = [np.asarray(jax.device_get(x))
+                           for x in ens.run_quantized(4, seed=25)]
+        data = data.astype(np.int16)
+        par = str(tmp_path / "pc.par")
+        make_par(ens.signal_shell(), ens.pulsar, outpar=par)
+
+        def write_all(cache, sub):
+            import copy
+
+            state = {"sig": copy.copy(ens.signal_shell()),
+                     "pulsar": ens.pulsar, "template": tmpl, "parfile": par,
+                     "MJD_start": 56000.0, "ref_MJD": 56000.0,
+                     "proto_cache": cache}
+            w = _FastObsWriter(state)
+            out = []
+            # alternate DMs so a 1-entry cache evicts on every write
+            for j, dm in enumerate([5.0, 25.0, 5.0, 25.0]):
+                p = str(tmp_path / f"{sub}_{j}.fits")
+                w.write(p, (data[j], scl[j], offs[j]), dm)
+                out.append(open(p, "rb").read())
+            return out
+
+        assert write_all(1, "evict") == write_all(8, "keep")
 
 
 class TestWriterPoolAndManifest:

@@ -114,6 +114,35 @@ class TestKillResume:
         for a, b in zip(ref, got):
             assert open(a, "rb").read() == open(b, "rb").read(), b
 
+    def test_hetero_packed_kill_mid_group_resumes_bit_identical(
+            self, tmp_path):
+        """Per-pulsar grouped packed export (per-obs DMs in runs of 3,
+        obs_per_file=3) killed after chunk 0's commit: chunk 0 (8 obs)
+        completes groups 0-1 and leaves group 2 HALF-FILLED in the
+        packer when the process dies — the mid-group boundary case.
+        Resume must regroup identically (grouping is a pure function of
+        the fingerprinted dms) and regenerate the unwritten groups
+        byte-identical to an uninterrupted hetero export."""
+        hetero = ["--hetero-run-len", "3", "--obs-per-file", "3"]
+        ref = str(tmp_path / "het_clean")
+        _run_export(ref, extra=hetero)
+        ref_paths = _fits(ref)
+        assert len(ref_paths) == N_OBS // 3
+        out = str(tmp_path / "het_killed")
+        plan_file = _write_plan(tmp_path, "hkill",
+                                {"run.kill": {"after_start": 0}})
+        _run_export(out, plan_file=plan_file, expect_kill=True,
+                    extra=hetero)
+        survivors = _fits(out)
+        # groups 0-1 committed, the straddling group 2 died in-buffer
+        assert 0 < len(survivors) < len(ref_paths)
+        _run_export(out, resume_mode="verify", extra=hetero)
+        got = _fits(out)
+        assert [os.path.basename(p) for p in got] == \
+               [os.path.basename(p) for p in ref_paths]
+        for a, b in zip(ref_paths, got):
+            assert open(a, "rb").read() == open(b, "rb").read(), b
+
     def test_partial_file_kill_then_verify_resume(self, clean_dir,
                                                   tmp_path):
         """file.partial tears obs_00009 mid-write and SIGKILLs: the .tmp

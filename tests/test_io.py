@@ -154,8 +154,10 @@ class TestPolyco:
         base, _ = self._write_par(tmp_path)
         base_text = open(base).read()
         # GLEP_1 alone is accepted since round 5 (glitch terms
-        # implemented); GLWEIRD_1 stands in as the unknown-glitch case
-        for extra in ("GLWEIRD_1 1.0", "UNITS TCB", "BINARY T2",
+        # implemented); GLWEIRD_1 stands in as the unknown-glitch case,
+        # and UNITS TCB is accepted (converted) since round 10 — UNITS SI
+        # stands in as the unknown-units case
+        for extra in ("GLWEIRD_1 1.0", "UNITS SI", "BINARY T2",
                       "FB1 1e-20", "PB 67.8"):
             par = str(tmp_path / "bad.par")
             with open(par, "w") as f:

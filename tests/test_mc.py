@@ -321,6 +321,35 @@ class TestBridges:
             man = json.load(f)
         assert "mc_study" in man  # provenance stamp
 
+    def test_export_psrfits_packed_hetero_matches_direct(self, tmp_path):
+        """The per-pulsar grouped packed layout through the study bridge:
+        a dm-prior study exports with ``obs_per_file > 1`` (previously
+        rejected — per-obs DMs locked studies out of packing) and is
+        byte-identical to the direct ensemble export of the same sampled
+        DMs packed the same way.  A Choice prior over two DM values makes
+        adjacent equal draws genuinely pack into multi-obs groups."""
+        from psrsigsim_tpu.io import export_ensemble_psrfits
+        from psrsigsim_tpu.io.export import _GroupPacker
+        from psrsigsim_tpu.mc import Choice
+
+        study = _study({"dm": Choice((9.0, 14.0))})
+        d1, d2 = str(tmp_path / "study_p"), str(tmp_path / "direct_p")
+        paths1 = study.export_psrfits(8, d1, TEMPLATE, supervised=False,
+                                      writers=1, chunk_size=4,
+                                      obs_per_file=4)
+        dms = np.asarray(study.sampled_params(8)[:, 0], np.float64)
+        packer = _GroupPacker(8, 4, dms=dms)
+        assert len(paths1) == packer.n_groups < 8  # some groups packed
+        ens = Simulation(psrdict=dict(SIM_CONFIG)).to_ensemble()
+        paths2 = export_ensemble_psrfits(ens, 8, d2, TEMPLATE, ens.pulsar,
+                                         seed=study.seed, dms=dms,
+                                         writers=1, chunk_size=4,
+                                         obs_per_file=4)
+        assert ([os.path.basename(p) for p in paths1]
+                == [os.path.basename(p) for p in paths2])
+        for a, b in zip(paths1, paths2):
+            assert open(a, "rb").read() == open(b, "rb").read()
+
     def test_export_psrfits_rejects_profile_priors(self, tmp_path):
         study = _study({"width": Uniform(0.02, 0.08)})
         with pytest.raises(NotImplementedError, match="width"):

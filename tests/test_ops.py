@@ -340,3 +340,66 @@ class TestFlatNormalField:
                                          8 * FLAT_TILE))
         assert abs(x.mean()) < 5e-3
         assert abs(x.std() - 1.0) < 5e-3
+
+
+class TestFlatChi2Field:
+    """SEARCH-mode whole-tile chi2 stream (ops/stats.py flat_chi2_field):
+    an elementwise transform of the flat normal stream, so span/shard
+    invariance is inherited bit-for-bit and df=1 draws ARE the squared
+    flat normals."""
+
+    def test_df1_is_squared_flat_normals(self):
+        import jax
+
+        from psrsigsim_tpu.ops.stats import (FLAT_TILE, flat_chi2_field,
+                                             flat_normal_field)
+
+        key = jax.random.key(5)
+        z = np.asarray(flat_normal_field(key, 0, FLAT_TILE))
+        x = np.asarray(flat_chi2_field(key, 0, FLAT_TILE, 1.0))
+        np.testing.assert_array_equal(x, z * z)
+
+    def test_any_span_reproduces_the_global_stream(self):
+        import jax
+        import jax.numpy as jnp
+
+        from psrsigsim_tpu.ops.stats import FLAT_TILE, flat_chi2_field
+
+        key = jax.random.key(9)
+        whole = np.asarray(flat_chi2_field(key, 0, 2 * FLAT_TILE, 1.0))
+        f0, ln = 23456, 30000
+        span = np.asarray(jax.jit(
+            lambda o: flat_chi2_field(key, o, ln, 1.0))(jnp.int32(f0)))
+        np.testing.assert_array_equal(span, whole[f0:f0 + ln])
+
+    def test_wh_branch_statistics_and_guards(self):
+        import jax
+        import pytest
+
+        from psrsigsim_tpu.ops.stats import flat_chi2_field, flat_chi2_ok
+
+        df = 200.0
+        x = np.asarray(flat_chi2_field(jax.random.key(2), 0, 1 << 18, df))
+        assert abs(x.mean() - df) < 0.05 * df
+        assert abs(x.var() - 2 * df) < 0.1 * 2 * df
+        assert (x >= 0).all()
+        # small static df has no flat-normal form (gamma sampler)
+        assert not flat_chi2_ok(7.0)
+        with pytest.raises(ValueError, match="flat_chi2_field"):
+            flat_chi2_field(jax.random.key(2), 0, 64, 7.0)
+        # global flat extents past int32 must stay on the per-channel
+        # path (traced offsets would silently wrap)
+        from psrsigsim_tpu.ops.stats import FLAT_MAX_OFFSET
+
+        assert flat_chi2_ok(1.0, span_end=FLAT_MAX_OFFSET)
+        assert not flat_chi2_ok(1.0, span_end=FLAT_MAX_OFFSET + 1)
+
+    def test_exact_chi2_env_disables_flat(self, monkeypatch):
+        from psrsigsim_tpu.ops.stats import flat_chi2_ok
+
+        assert flat_chi2_ok(1.0)
+        monkeypatch.setenv("PSS_EXACT_CHI2", "1")
+        # the exact-gamma escape hatch must steer every draw back to the
+        # blocked per-channel samplers
+        assert not flat_chi2_ok(1.0)
+        assert not flat_chi2_ok(200.0)

@@ -1,0 +1,218 @@
+"""The shared program registry (psrsigsim_tpu/runtime/programs.py):
+build-once semantics, compile-count telemetry, and the ensemble/MC/export
+families actually resolving through it."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from psrsigsim_tpu.runtime.programs import ProgramRegistry, global_registry
+from psrsigsim_tpu.runtime.telemetry import StageTimers
+
+TEMPLATE = os.path.join(
+    os.path.dirname(__file__), "..", "data",
+    "B1855+09.L-wide.PUPPI.11y.x.sum.sm")
+
+SIM = {
+    "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+    "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+    "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+    "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+    "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+    "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+    "rcvr_name": "R", "backend_samprate": 12.5, "backend_name": "B",
+}
+
+
+class TestProgramRegistry:
+    def test_build_once_then_hit(self):
+        reg = ProgramRegistry("t")
+        calls = []
+
+        def build():
+            calls.append(1)
+            return object()
+
+        a = reg.get_or_build(("fam", 1), build)
+        b = reg.get_or_build(("fam", 1), build)
+        assert a is b and calls == [1]
+        assert reg.build_counts() == {("fam", 1): 1}
+        assert reg.hit_counts() == {("fam", 1): 1}
+        reg.assert_single_build()
+        reg.assert_single_build("fam")
+
+    def test_concurrent_build_keeps_one_artifact(self):
+        import threading
+
+        reg = ProgramRegistry("t")
+        gate = threading.Barrier(4)
+        got = []
+
+        def worker():
+            gate.wait()
+            got.append(reg.get_or_build(("k",), lambda: object()))
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len({id(x) for x in got}) == 1
+        # losers of the race may have built extra artifacts; exactly one
+        # is kept, and the counts record what happened
+        assert reg.build_counts()[("k",)] >= 1
+
+    def test_timers_receive_compile_telemetry(self):
+        reg = ProgramRegistry("t")
+        timers = StageTimers()
+        reg.attach_timers(timers)
+        reg.get_or_build(("a", 1), lambda: object())
+        reg.get_or_build(("a", 2), lambda: object())
+        reg.get_or_build(("a", 1), lambda: object())  # hit: no telemetry
+        snap = timers.snapshot()
+        assert snap["compile_calls"] == 2
+        assert snap["program_builds_count"] == 2
+
+    def test_snapshot_aggregates_by_family_and_is_json(self):
+        reg = ProgramRegistry("t")
+        reg.get_or_build(("fold", "g1"), lambda: object())
+        reg.get_or_build(("fold", "g2"), lambda: object())
+        reg.get_or_build(("quant", "g1"), lambda: object())
+        reg.get_or_build(("fold", "g1"), lambda: object())
+        snap = reg.snapshot()
+        json.dumps(snap)  # manifest/bench-safe
+        assert snap["builds_by_family"] == {"fold": 2, "quant": 1}
+        assert snap["hits_by_family"] == {"fold": 1}
+        assert snap["programs"] == 3 and snap["builds_total"] == 3
+
+    def test_lru_cap_bounds_artifacts_and_rebuilds(self):
+        reg = ProgramRegistry("t", max_programs=2)
+        a = reg.get_or_build(("f", 1), lambda: object())
+        reg.get_or_build(("f", 2), lambda: object())
+        reg.get_or_build(("f", 3), lambda: object())  # evicts ("f", 1)
+        snap = reg.snapshot()
+        assert snap["programs"] == 2 and snap["evictions"] == 1
+        b = reg.get_or_build(("f", 1), lambda: object())  # rebuilt
+        assert b is not a
+        assert reg.build_counts()[("f", 1)] == 2
+
+    def test_trace_env_key_changes_registry_keys(self, monkeypatch):
+        """The PSS_* trace-time hatches are part of a program's
+        identity: flipping one must re-trace, never hit the cache built
+        under the old settings (per-instance jit caches used to give
+        that for free)."""
+        from psrsigsim_tpu.runtime.programs import trace_env_key
+        from psrsigsim_tpu.simulate import Simulation
+
+        base = trace_env_key()
+        monkeypatch.setenv("PSS_EXACT_CHI2", "1")
+        assert trace_env_key() != base
+        s = Simulation(psrdict=dict(SIM))
+        s.init_all()
+        before = global_registry().snapshot()["builds_total"]
+        s.to_ensemble()   # same geometry as other tests, NEW env key
+        assert global_registry().snapshot()["builds_total"] > before
+
+    def test_assert_single_build_flags_duplicates(self):
+        reg = ProgramRegistry("t")
+        reg._builds[("fam", "x")] = 2  # simulate a rebuilt key
+        with pytest.raises(AssertionError, match="more than once"):
+            reg.assert_single_build()
+        reg2 = ProgramRegistry("t2")
+        reg2._builds[("other", "x")] = 2
+        reg2.assert_single_build("fam")  # family filter passes
+
+
+class TestSharedResolution:
+    def test_same_geometry_ensembles_share_programs(self):
+        from psrsigsim_tpu.simulate import Simulation
+
+        s1 = Simulation(psrdict=dict(SIM))
+        s1.init_all()
+        e1 = s1.to_ensemble()
+        before = global_registry().snapshot()["builds_total"]
+        s2 = Simulation(psrdict=dict(SIM))
+        s2.init_all()
+        e2 = s2.to_ensemble()
+        after = global_registry().snapshot()["builds_total"]
+        assert after == before, "same geometry re-built programs"
+        assert e2._run_sharded is e1._run_sharded
+        assert (e2._run_sharded_quantized_packed
+                is e1._run_sharded_quantized_packed)
+        # and the shared programs stay bit-identical across instances
+        import jax
+
+        a = np.asarray(jax.device_get(e1.run(2, seed=0)))
+        b = np.asarray(jax.device_get(e2.run(2, seed=0)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_different_geometry_builds_new_programs(self):
+        from psrsigsim_tpu.simulate import Simulation
+
+        d = dict(SIM)
+        d["Nchan"] = 8
+        s = Simulation(psrdict=d)
+        s.init_all()
+        before = global_registry().snapshot()["builds_total"]
+        s.to_ensemble()
+        after = global_registry().snapshot()["builds_total"]
+        assert after > before
+
+    def test_mc_studies_share_trial_programs(self):
+        from psrsigsim_tpu.mc import MonteCarloStudy, Uniform
+        from psrsigsim_tpu.simulate import Simulation
+
+        def mk():
+            return MonteCarloStudy.from_simulation(
+                Simulation(psrdict=dict(SIM)), {"dm": Uniform(5.0, 9.0)},
+                seed=11)
+
+        st1 = mk()
+        p1 = st1._program(8)
+        before = global_registry().snapshot()["builds_total"]
+        st2 = mk()
+        assert st2._program(8) is p1
+        assert global_registry().snapshot()["builds_total"] == before
+        # a different prior space is a different program
+        st3 = MonteCarloStudy.from_simulation(
+            Simulation(psrdict=dict(SIM)), {"dm": Uniform(5.0, 19.0)},
+            seed=11)
+        assert st3._program(8) is not p1
+
+    def test_registry_does_not_pin_discarded_studies(self):
+        """The cached MC trial program closes over a slim context, not
+        the study: dropping the study must free it even while the
+        registry keeps the compiled program alive."""
+        import gc
+        import weakref
+
+        from psrsigsim_tpu.mc import MonteCarloStudy, Uniform
+        from psrsigsim_tpu.simulate import Simulation
+
+        st = MonteCarloStudy.from_simulation(
+            Simulation(psrdict=dict(SIM)), {"dm": Uniform(6.0, 7.0)},
+            seed=21)
+        st._program(8)
+        ref = weakref.ref(st)
+        del st
+        gc.collect()
+        assert ref() is None, (
+            "registry-cached trial program pinned the study object")
+
+    def test_export_manifest_records_registry_snapshot(self, tmp_path):
+        from psrsigsim_tpu.io import export_ensemble_psrfits
+        from psrsigsim_tpu.simulate import Simulation
+
+        s = Simulation(psrdict=dict(SIM))
+        s.init_all()
+        ens = s.to_ensemble()
+        out = str(tmp_path / "reg")
+        export_ensemble_psrfits(ens, 2, out, TEMPLATE, ens.pulsar, seed=0,
+                                chunk_size=2, writers=1)
+        with open(os.path.join(out, "export_manifest.json")) as f:
+            man = json.load(f)
+        progs = man["pipeline"]["programs"]
+        assert progs["registry"] == "global"
+        assert "ensemble_quantized_packed" in progs["builds_by_family"]

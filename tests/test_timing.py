@@ -162,16 +162,80 @@ class TestTimingModel:
         assert m.dm_at(mid) == pytest.approx(m.dm + v, abs=1e-9)
         assert m.dm_at(r1 - 10.0) != pytest.approx(m.dm + v, abs=abs(v) / 2)
 
-    def test_strict_rejects_tcb_and_unknown_binary(self, tmp_path):
+    def test_strict_rejects_unknown_units_and_binary(self, tmp_path):
         base = ("PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\nF0 100.0\n"
                 "PEPOCH 56000\nDM 10.0\nTZRSITE @\n")
-        for extra in ("UNITS TCB\n", "BINARY T2\n"):
+        for extra in ("UNITS SI\n", "BINARY T2\n"):
             par = tmp_path / "bad.par"
             par.write_text(base + extra)
             with pytest.raises(UnsupportedTimingModelError):
                 TimingModel.from_par(str(par))
             # non-strict builds the model from the supported subset
             TimingModel.from_par(str(par), strict=False)
+
+    def test_tcb_par_accepted_and_converted(self, tmp_path):
+        """UNITS TCB no longer rejects: the model converts epochs and
+        dimensioned parameters to TDB at construction (IAU L_B scaling)."""
+        par = tmp_path / "tcb.par"
+        par.write_text("PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\n"
+                       "F0 100.0\nPEPOCH 56000\nDM 10.0\nTZRSITE @\n"
+                       "UNITS TCB\n")
+        m = TimingModel.from_par(str(par))
+        assert m.params["UNITS"] == "TDB"
+        # F0 scaled up (TCB seconds are shorter), PEPOCH mapped back
+        assert float(m.f_terms[0]) == pytest.approx(
+            100.0 * (1 + 1.550519768e-8), rel=1e-12)
+        assert float(m.pepoch) < 56000.0
+        assert m.dm == pytest.approx(10.0 * (1 + 1.550519768e-8),
+                                     rel=1e-12)
+
+    def test_tcb_phase_matches_equivalent_tdb_par(self, tmp_path):
+        """The pin: a TDB par and its exactly-equivalent TCB par (built
+        by the inverse IAU transformation in longdouble) predict the
+        same absolute phase to <1e-6 cycles across a +-30 day span —
+        epochs, spin terms, DM and binary terms all transformed."""
+        from psrsigsim_tpu.io.timing import (_SEC_PER_DAY, _TCB_L_B,
+                                             _TCB_T0_MJD, _TCB_TDB0_S)
+
+        one_minus = np.longdouble(1.0) - np.longdouble(_TCB_L_B)
+
+        def inv_epoch(tdb):
+            # invert TDB = TCB - L_B (TCB - T0) + TDB0 for TCB
+            t = np.longdouble(tdb)
+            return ((t - np.longdouble(_TCB_TDB0_S) / _SEC_PER_DAY
+                     - np.longdouble(_TCB_L_B) * _TCB_T0_MJD)
+                    / one_minus)
+
+        def fmt(x):
+            return np.format_float_positional(np.longdouble(x),
+                                              unique=True, trim="0")
+
+        f0, f1 = 339.31568, -1.6e-15
+        pepoch, t0 = 56000.0, 55990.5
+        pb, a1, dm = 0.6, 0.9, 21.3
+        tdb_par = tmp_path / "tdb.par"
+        tdb_par.write_text(
+            "PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\n"
+            f"F0 {fmt(f0)}\nF1 {f1}\nPEPOCH {fmt(pepoch)}\n"
+            f"DM {fmt(dm)}\nBINARY BT\nPB {fmt(pb)}\nA1 {fmt(a1)}\n"
+            f"T0 {fmt(t0)}\nECC 0.01\nOM 45.0\nTZRSITE @\n"
+            f"TZRMJD {fmt(pepoch)}\nUNITS TDB\n")
+        tcb_par = tmp_path / "tcb.par"
+        tcb_par.write_text(
+            "PSR J0000+0000\nLAMBDA 100.0\nBETA 20.0\n"
+            f"F0 {fmt(np.longdouble(f0) * one_minus)}\n"
+            f"F1 {fmt(np.longdouble(f1) * one_minus ** 2)}\n"
+            f"PEPOCH {fmt(inv_epoch(pepoch))}\n"
+            f"DM {fmt(np.longdouble(dm) * one_minus)}\n"
+            f"BINARY BT\nPB {fmt(np.longdouble(pb) / one_minus)}\n"
+            f"A1 {fmt(np.longdouble(a1) / one_minus)}\n"
+            f"T0 {fmt(inv_epoch(t0))}\nECC 0.01\nOM 45.0\nTZRSITE @\n"
+            f"TZRMJD {fmt(inv_epoch(pepoch))}\nUNITS TCB\n")
+        m_tdb = TimingModel.from_par(str(tdb_par))
+        m_tcb = TimingModel.from_par(str(tcb_par))
+        t = np.linspace(pepoch - 30.0, pepoch + 30.0, 61)
+        dphi = np.asarray(m_tcb.phase(t) - m_tdb.phase(t), np.float64)
+        assert np.max(np.abs(dphi)) < 1e-6, np.max(np.abs(dphi))
 
     def test_parse_par_full_longdouble_epochs(self):
         p = parse_par_full(J1713_PAR)
