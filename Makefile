@@ -24,9 +24,15 @@
 # byte identity vs a solo run, zero committed cache artifacts lost,
 # per-replica single-compile, supervisor restart/recovery, and the
 # multi-process cache contention stress (bench.py fleet_smoke).
+# `make elastic-smoke` is the overload-survival gate: traffic-ramp
+# scale-up/scale-down byte identity + zero lost commits across
+# membership changes, circuit-breaker ejection of an injected-slow
+# replica with half-open recovery, ENOSPC pass-through degradation,
+# and saturation 429/Retry-After + admission shedding
+# (bench.py elastic_smoke).
 
 .PHONY: lint test test-faults bench-export bench-mc serve-smoke \
-	bench-scenarios fleet-smoke
+	bench-scenarios fleet-smoke elastic-smoke
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -51,3 +57,6 @@ bench-scenarios:
 
 fleet-smoke:
 	JAX_PLATFORMS=cpu python bench.py --fleet-smoke
+
+elastic-smoke:
+	JAX_PLATFORMS=cpu python bench.py --elastic-smoke

@@ -1852,6 +1852,92 @@ def fleet_smoke():
             "ok": True}
 
 
+# ---------------------------------------------------------------------------
+# Config 11: elastic fleet (autoscaling + overload survival, PR 11)
+# ---------------------------------------------------------------------------
+
+
+def time_elastic(n_max=None, n_requests=None):
+    """Config 11: throughput and p99 at 1x/2x/4x of a nominal concurrent
+    load, FIXED single replica vs AUTOSCALED fleet (min 1, max N) —
+    the capacity the autoscaler adds under saturation, measured.  On
+    one chip the replicas time-share the device, so this measures
+    serving-path elasticity (queue wait absorbed by added replicas),
+    not device scaling."""
+    import shutil
+    import tempfile
+
+    if n_max is None:
+        n_max = int(os.environ.get("PSS_BENCH_ELASTIC_MAX_REPLICAS", "2"))
+    if n_requests is None:
+        n_requests = int(os.environ.get("PSS_BENCH_ELASTIC_REQUESTS", "6"))
+    out = tempfile.mkdtemp(prefix="pss_elastic_bench_")
+    try:
+        v = _run_fleet_runner(
+            ["--mode", "elastic-bench", "--out", out,
+             "--max-replicas", str(n_max),
+             "--requests", str(n_requests), "--threads", "3"])
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    if not v["ok"]:
+        raise RuntimeError(f"elastic bench verdict not ok: {v}")
+    out = {"max_replicas": n_max, "base_requests": n_requests,
+           "scale_events": v["scale_events"],
+           "max_active": v["max_active"],
+           "elastic_over_fixed": v["elastic_over_fixed_4x"]}
+    for m in ("1x", "2x", "4x"):
+        out[f"fixed_req_per_sec_{m}"] = v["fixed"][m]["req_per_sec"]
+        out[f"elastic_req_per_sec_{m}"] = v["elastic"][m]["req_per_sec"]
+        out[f"fixed_p99_s_{m}"] = v["fixed"][m]["p99_s"]
+        out[f"elastic_p99_s_{m}"] = v["elastic"][m]["p99_s"]
+        out[f"fixed_rejected_{m}"] = v["fixed"][m]["rejected"]
+        out[f"elastic_rejected_{m}"] = v["elastic"][m]["rejected"]
+    out["elastic_req_per_sec_4x_over_fixed"] = out["elastic_over_fixed"]
+    return out
+
+
+def elastic_smoke():
+    """Quick elastic-fleet gate (``make elastic-smoke``): the PR 11
+    overload-survival proof — (a) a traffic ramp drives a scale-UP then
+    an idle scale-DOWN with every response byte-identical to a solo
+    single-replica run and zero lost/torn cache commits across the
+    membership changes; (b) an injected alive-but-slow replica
+    (``replica.slow``) is ejected by the router's latency circuit
+    breaker (slow responses bounded by the injection budget — p99 stays
+    bounded during ejection) and recovers through the half-open probe
+    once the fault clears; (c) ``cache.enospc`` degrades the cache tier
+    to pass-through serving (requests still byte-identical, loud
+    ``cache_put_errors`` metric, no leaked claims/tmps, clean verify);
+    (d) at saturation, rejects carry 429/503 with a positive
+    (load-proportional) Retry-After, hopeless deadlines are SHED at
+    admission, and no generous-deadline accepted request expires."""
+    import shutil
+    import tempfile
+
+    out = tempfile.mkdtemp(prefix="pss_elastic_smoke_")
+    try:
+        v = _run_fleet_runner(
+            ["--mode", "elastic", "--out", out], timeout=1200)
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    assert v["byte_identical"], (
+        "elastic fleet responses NOT byte-identical to the solo run: "
+        f"{v.get('mismatches')}")                              # (a)
+    assert v["ramp_ok"], f"ramp leg failed: {v['ramp']}"       # (a)
+    assert v["ramp"]["scaled_up"] and v["ramp"]["scaled_down"], v["ramp"]
+    assert v["ramp"]["lost_commits"] == 0, v["ramp"]
+    assert v["gray_ok"], f"gray-failure leg failed: {v['gray']}"  # (b)
+    assert v["gray"]["ejected"] and v["gray"]["recovered"], v["gray"]
+    assert v["gray"]["slow_responses"] <= v["gray"]["slow_budget"], \
+        v["gray"]
+    assert v["enospc_ok"], f"ENOSPC leg failed: {v['enospc']}"  # (c)
+    assert v["sat_ok"], f"saturation leg failed: {v['saturation']}"  # (d)
+    assert v["ok"], v
+    return {"metric": "elastic_smoke", "ramp": v["ramp"],
+            "gray": v["gray"], "enospc": v["enospc"],
+            "saturation": v["saturation"], "ok": True}
+
+
 _SCENARIO_STACKS = ("scintillation", "rfi", "single_pulse",
                     "scintillation+rfi+single_pulse:powerlaw")
 
@@ -2153,6 +2239,10 @@ _COMPACT_FIELDS = (
     ("serial_req_per_sec", "sreq_s", 1),
     ("fleet_req_per_sec", "freq_s", 1),
     ("fleet_over_solo", "fspd", 2),
+    ("elastic_req_per_sec_4x_over_fixed", "espd", 2),
+    ("elastic_req_per_sec_4x", "ereq4", 1),
+    ("elastic_p99_s_4x", "ep99", 3),
+    ("max_active", "mact", None),
     ("request_p99_s", "p99_s", 4),
     ("cache_hit_req_per_sec", "hit_s", 1),
     ("subint_encode_speedup", "enc_spd", 1),
@@ -2269,6 +2359,14 @@ def main():
         # zero-lost-commit + per-replica single-compile + cache stress
         with contextlib.redirect_stdout(sys.stderr):
             result = fleet_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
+    if "--elastic-smoke" in sys.argv[1:]:
+        # `make elastic-smoke`: scale-up/down byte identity + breaker
+        # ejection of an injected-slow replica + ENOSPC pass-through +
+        # saturation 429/Retry-After gates
+        with contextlib.redirect_stdout(sys.stderr):
+            result = elastic_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
     if "--scenario-smoke" in sys.argv[1:]:
@@ -2454,6 +2552,18 @@ def _main():
         f"{flt['solo_req_per_sec']:.1f} req/s "
         f"({flt['fleet_over_solo']:.2f}x; byte_identical="
         f"{flt['byte_identical']}, per_replica {flt['per_replica']})")
+    _checkpoint(detail)
+
+    # --- config 11: elastic fleet (fixed vs autoscaled) -----------------
+    ela = time_elastic()
+    detail["config11_elastic"] = ela
+    log(f"config11_elastic: 4x load fixed "
+        f"{ela['fixed_req_per_sec_4x']:.1f} req/s "
+        f"(p99 {ela['fixed_p99_s_4x']:.2f}s) vs autoscaled(max "
+        f"{ela['max_replicas']}) {ela['elastic_req_per_sec_4x']:.1f} "
+        f"req/s (p99 {ela['elastic_p99_s_4x']:.2f}s) -> "
+        f"{ela['elastic_over_fixed']:.2f}x; scale_events "
+        f"{ela['scale_events']}, max_active {ela['max_active']}")
     _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------

@@ -85,6 +85,32 @@ point                     where it fires
                           the replica itself stays healthy.  Config:
                           ``times`` / ``match`` (token is the replica
                           id).
+``replica.slow``          the replica HTTP front end
+                          (:mod:`psrsigsim_tpu.serve.http`), before a
+                          ``/simulate`` request is handled — sleeps
+                          ``delay_s`` so the replica is alive-but-slow
+                          (the GRAY failure health polling cannot see:
+                          ``/healthz`` still answers instantly), which
+                          the router's latency circuit breaker must
+                          eject.  Config: ``{"delay_s": float}`` plus
+                          ``times`` / ``match`` (token is the replica
+                          id, so one plan can slow exactly one fleet
+                          member).
+``cache.enospc``          :meth:`psrsigsim_tpu.serve.ResultCache.put`
+                          — raises ``OSError(ENOSPC)`` mid-commit, the
+                          disk-full case for the shared cache tier.
+                          ``at: "artifact"`` (default) fires after the
+                          tmp bytes are written but before rename, so
+                          the cleanup path MUST unlink the tmp and
+                          release the claim; ``at: "journal"`` fires
+                          before the journal append, leaving a durable
+                          but unindexed artifact (the same benign state
+                          a SIGKILL between rename and append leaves).
+                          The serving engine degrades to pass-through
+                          (result served uncached, loud metric), never
+                          a failed request.  Config: ``{"at": str}``
+                          plus ``times`` / ``match`` (token is the
+                          spec hash).
 ========================  ====================================================
 
 Arming is explicit and local: a :class:`FaultPlan` is built by a test and
@@ -110,7 +136,8 @@ __all__ = ["FaultPlan", "should_fire", "crash_process", "POINTS"]
 
 POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
           "run.kill", "mc.kill", "serve.kill", "serve.reject",
-          "replica.kill", "cache.contend", "route.blackhole")
+          "replica.kill", "cache.contend", "route.blackhole",
+          "replica.slow", "cache.enospc")
 
 
 class FaultPlan:

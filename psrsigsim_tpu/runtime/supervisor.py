@@ -546,6 +546,31 @@ class ProcessSupervisor:
             except (ProcessLookupError, OSError):
                 pass
 
+    def restart(self, sig=signal.SIGTERM, kill_after_s=30.0):
+        """GRACEFUL restart: send ``sig`` (drain) and let the watcher
+        respawn the child when it exits — in-flight work finishes, then
+        the process is replaced.  A child that ignores the drain signal
+        is SIGKILLed after ``kill_after_s`` (the gray-failure case this
+        exists for: a wedged replica may be too sick to honor SIGTERM).
+        Non-blocking; the escalation runs on a daemon thread."""
+        with self._lock:
+            proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        self.kill(sig)
+
+        def _escalate():
+            try:
+                proc.wait(kill_after_s)
+            except subprocess.TimeoutExpired:
+                try:
+                    proc.kill()
+                except (ProcessLookupError, OSError):
+                    pass
+
+        threading.Thread(target=_escalate, daemon=True,
+                         name=f"pss-restart-{self.name}").start()
+
     def stop(self, sig=signal.SIGTERM, timeout=30.0):
         """Orchestrated shutdown: no restart, ``sig`` (drain) first,
         SIGKILL after ``timeout``.  Returns the child's returncode (None

@@ -112,6 +112,7 @@ class StageTimers:
         self._bytes_fetched = 0
         self._depths = {}  # queue name -> [sum, samples, max]
         self._counters = {}  # name -> int (program builds, cache events...)
+        self._gauges = {}  # name -> last-set value (degraded flags, levels)
 
     def add(self, stage, seconds, nbytes=0):
         """Accumulate ``seconds`` of busy time against ``stage`` (one of
@@ -158,6 +159,20 @@ class StageTimers:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def gauge(self, name, value):
+        """Set a named point-in-time gauge (e.g. ``cache_degraded`` while
+        the serving cache tier is in ENOSPC pass-through, or a fleet's
+        ``active_replicas``): unlike counters these carry the CURRENT
+        value, not an accumulation, and ride snapshots as
+        ``<name>_gauge`` so /metrics and bench JSON see state, not just
+        history."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_value(self, name, default=None):
+        with self._lock:
+            return self._gauges.get(name, default)
+
     def depth(self, name, value):
         """Record one bounded-queue depth sample (e.g. the fetched-chunk
         queue right before the consumer pops it: 0 means the consumer
@@ -191,6 +206,8 @@ class StageTimers:
             out["wall_s"] = round(time.perf_counter() - self._t0, 6)
             for name, n in sorted(self._counters.items()):
                 out[f"{name}_count"] = n
+            for name, v in sorted(self._gauges.items()):
+                out[f"{name}_gauge"] = v
             for name, (tot, n, mx) in sorted(self._depths.items()):
                 out[f"{name}_depth_max"] = mx
                 out[f"{name}_depth_mean"] = round(tot / max(n, 1), 3)

@@ -27,13 +27,21 @@ The subsystem that turns the batch pipelines into a service
 - :mod:`~psrsigsim_tpu.serve.fleet` — :class:`ReplicaFleet`: N
   supervised server subprocesses over ONE shared cache dir,
   health-checked via ``/healthz``, restarted with jittered backoff,
-  drained fleet-wide on SIGTERM, degraded gracefully below quorum.
+  drained fleet-wide on SIGTERM, degraded gracefully below quorum —
+  and ELASTIC: a hysteresis control loop scales the fleet between
+  ``min_replicas`` and ``max_replicas`` from the queue-depth/p95
+  signals the health poll already collects, spawning warm replicas
+  (shared persistent compilation cache) and retiring them via the
+  lossless SIGTERM drain.
 - :mod:`~psrsigsim_tpu.serve.router` — :class:`FleetRouter` /
   ``make_router_server``: consistent ``spec_hash`` rendezvous routing
   (identical in-flight specs coalesce at one replica) with
   deadline-preserving failover when a replica dies — at-most-once
   device work via the shared cache, bit-identical bytes via the
-  (seed, spec_hash) key fold.
+  (seed, spec_hash) key fold — plus per-replica circuit breakers
+  (latency EWMA + consecutive-error counting, closed -> open ->
+  half-open probe) that eject alive-but-slow GRAY replicas health
+  polling cannot see.
 """
 
 from .cache import ResultCache

@@ -38,6 +38,10 @@ def main(argv=None):
                     help="content-addressed result cache root (also hosts "
                          "the persistent compilation cache); omit to "
                          "disable caching")
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="persistent compilation cache override (fleets "
+                         "share one across result-cache dirs so scale-up "
+                         "warms from disk)")
     ap.add_argument("--widths", default="1,8,32",
                     help="comma-separated bucket widths")
     ap.add_argument("--max-queue", type=int, default=64)
@@ -78,6 +82,7 @@ def main(argv=None):
         cache_dir=args.cache_dir, widths=widths, max_queue=args.max_queue,
         batch_window_s=args.batch_window_ms / 1e3,
         verify_cache=args.verify_cache, faults=faults,
+        compile_cache_dir=args.compile_cache_dir,
         replica_id=args.replica_id)
 
     if args.warmup:

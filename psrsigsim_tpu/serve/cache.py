@@ -47,16 +47,27 @@ passes ``compact_min_dead`` the journal is rewritten at open — live
 records only, temp + fsync + atomic rename, under the cross-process lock
 — so long-lived cache dirs stop replaying unbounded history.
 
+**Write failures degrade, never wedge.**  Any ``OSError`` during the
+artifact tmp write / fsync / rename or the journal append (ENOSPC being
+the canonical case) unlinks the partial tmp, releases the per-hash
+claim marker, bumps ``write_errors``, and re-raises — so a failed
+writer leaves no torn journal, no orphan tmp, and no claim squatting
+until ``claim_timeout_s``.  The serving engine catches the re-raise and
+degrades to pass-through (the computed result is still served, just
+not cached) with a loud ``cache_put_errors`` metric.
+
 The ``serve.kill`` fault point fires here, immediately after a journal
 commit (and deliberately before the claim marker is released, so the
 relaunch path also proves orphan-claim cleanup); ``cache.contend``
 sleeps inside the claim-held / journal-absent window so contention
-stress tests reliably hit the race the discipline exists for.
+stress tests reliably hit the race the discipline exists for;
+``cache.enospc`` injects the disk-full OSError at either commit stage.
 """
 
 from __future__ import annotations
 
 import contextlib
+import errno
 import fcntl
 import hashlib
 import io
@@ -126,6 +137,7 @@ class ResultCache:
         self.dropped = 0       # artifacts dropped by verify
         self.compacted = 0     # dead journal records dropped at open
         self.claim_breaks = 0  # stale claims this process broke
+        self.write_errors = 0  # commits aborted by OSError (ENOSPC, ...)
         with self._lock, self._flocked():
             self._open_journal_locked()
         if verify:
@@ -430,16 +442,20 @@ class ResultCache:
             with self._lock:
                 self._index.setdefault(h, won)
             return won
+        # artifact first (temp + fsync + atomic rename), journal
+        # second: an artifact is durable before it is indexable
+        path = self._artifact_path(h)
+        # pid + thread id: the tmp name must be unique across the
+        # PROCESS's threads too (N in-process caches over one dir is
+        # the fleet test topology), belt-and-braces under the claim
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         try:
-            # artifact first (temp + fsync + atomic rename), journal
-            # second: an artifact is durable before it is indexable
-            path = self._artifact_path(h)
-            # pid + thread id: the tmp name must be unique across the
-            # PROCESS's threads too (N in-process caches over one dir is
-            # the fleet test topology), belt-and-braces under the claim
-            tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
             with open(tmp, "wb") as f:
                 f.write(payload)
+                # cache.enospc at="artifact": the disk filled under the
+                # tmp write — the cleanup below must unlink the partial
+                # tmp and (via the outer finally) release the claim
+                self._maybe_enospc("artifact", h)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
@@ -450,6 +466,13 @@ class ResultCache:
                 if cfg is not None and should_fire(
                         self._faults, "cache.contend", token=h):
                     time.sleep(float(cfg.get("hold_s", 0.05)))
+            # cache.enospc at="journal": the artifact is durably renamed
+            # but its journal line cannot be written — the same benign
+            # unindexed-artifact state a SIGKILL between rename and
+            # append leaves (invisible to readers, re-renamed over by
+            # the next writer); the journal itself is never torn because
+            # nothing was appended
+            self._maybe_enospc("journal", h)
             with self._lock:
                 with self._flocked():
                     self._refresh_locked()
@@ -468,10 +491,35 @@ class ResultCache:
                 if cfg is not None and puts >= int(cfg.get("after_puts", 1)):
                     if should_fire(self._faults, "serve.kill", token=h):
                         crash_process()
+        except OSError:
+            # write-failure cleanup (ENOSPC, EIO, a vanished mount): a
+            # failed writer must not wedge the per-hash single-writer
+            # claim until claim_timeout_s, and must not leave a partial
+            # tmp for audits to flag — unlink the tmp here, release the
+            # claim in the shared finally, and re-raise so the caller
+            # (the serving engine degrades to pass-through) decides
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            with self._lock:
+                self.write_errors += 1
+            raise
         finally:
             with contextlib.suppress(OSError):
                 os.unlink(self._claim_path(h))
         return rec
+
+    def _maybe_enospc(self, at, h):
+        """Injected disk-full (``cache.enospc`` fault point): raises
+        OSError(ENOSPC) when armed for stage ``at`` ("artifact" before
+        the tmp fsync/rename, "journal" before the journal append)."""
+        if self._faults is None:
+            return
+        cfg = self._faults.config("cache.enospc")
+        if cfg is None or cfg.get("at", "artifact") != at:
+            return
+        if should_fire(self._faults, "cache.enospc", token=h):
+            raise OSError(errno.ENOSPC,
+                          f"injected ENOSPC (cache.enospc at={at})")
 
     def stats(self):
         """JSON-ready counters for ``/metrics``."""
@@ -480,7 +528,8 @@ class ResultCache:
                     "misses": self.misses, "verified": self.verified,
                     "dropped": self.dropped, "puts": self._puts,
                     "compacted": self.compacted,
-                    "claim_breaks": self.claim_breaks}
+                    "claim_breaks": self.claim_breaks,
+                    "write_errors": self.write_errors}
 
     def close(self):
         with self._lock:

@@ -41,8 +41,10 @@ from __future__ import annotations
 import json
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..runtime.faults import should_fire
 from .service import RequestRejected, SimulationService
 from .spec import SpecError
 
@@ -76,6 +78,18 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path.rstrip("/") != "/simulate":
             return self._reply(404, {"error": f"no such endpoint {self.path}"})
+        # replica.slow (tests only): an alive-but-slow replica — the
+        # request IS answered, just late, and /healthz stays instant, so
+        # only the router's latency circuit breaker can see the gray
+        # failure.  Injected before any handling so the delay rides
+        # every path (cache hit included), like a wedged runtime would.
+        faults = getattr(self.service, "_faults", None)
+        if faults is not None:
+            cfg = faults.config("replica.slow")
+            if cfg is not None and should_fire(
+                    faults, "replica.slow",
+                    token=str(self.service.replica_id)):
+                time.sleep(float(cfg.get("delay_s", 1.0)))
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")

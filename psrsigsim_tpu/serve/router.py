@@ -19,12 +19,35 @@ replicas over one shared cache":
   the explicit-backpressure exception the single-server admission path
   already uses (:class:`~psrsigsim_tpu.serve.RequestRejected` with a
   retry-after), never hangs or half-serves.
+* **Gray-failure ejection (circuit breakers)** — health polling can
+  only see a replica that stops *answering*; a replica that answers
+  ``/healthz`` instantly but serves requests 10x slow (wedged runtime,
+  thermal throttle, noisy neighbor) would drag fleet p99 forever.  The
+  router keeps a per-replica latency EWMA and consecutive-error count
+  and wraps each replica in a circuit breaker: *closed* (routing
+  normally) -> *open* on ``breaker_fails`` consecutive transport
+  failures OR on a latency outlier (EWMA above ``breaker_outlier`` x
+  the median of the other closed replicas, past an absolute floor) ->
+  after ``breaker_reset_s`` a single *half-open* probe request is let
+  through — success closes the breaker, failure reopens it.  An open
+  replica is excluded from routing (its keys move by rendezvous
+  construction) and, with ``eject_restart``, handed to the supervisor
+  for a graceful SIGTERM restart.  Caveat: with blocking ``wait=True``
+  submits the measured latency INCLUDES the replica's queue wait, so a
+  healthy-but-busy replica (hot-key imbalance) can trip the outlier
+  check — for pure routing exclusion that is load shifting (its keys
+  move to idler replicas and the probe re-admits it as soon as it
+  answers fast), but leave ``eject_restart`` off (the default) unless
+  submits are async: restarting a merely-busy replica sheds capacity
+  exactly when it is scarce.
 
 Chaos points (armed only via an explicit FaultPlan): ``replica.kill``
 SIGKILLs the routed replica right *before* the configured request is
 forwarded — the hardest-case mid-traffic death, proving the re-route +
 restart path deterministically; ``route.blackhole`` makes a routed
-replica unreachable without killing it (the network-partition case).
+replica unreachable without killing it (the network-partition case);
+``replica.slow`` (armed on the replica side) makes one fleet member
+alive-but-slow, the gray failure the breaker exists for.
 
 ``make_router_server`` wraps the router in the same stdlib HTTP JSON
 API one replica speaks, so a fleet is a drop-in replacement for a
@@ -35,6 +58,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import signal
 import threading
 import time
@@ -47,6 +71,39 @@ from .service import RequestRejected
 from .spec import canonicalize, spec_hash
 
 __all__ = ["FleetRouter", "RouteFailed", "make_router_server"]
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+class _Breaker:
+    """Per-replica circuit-breaker state (mutated under the router
+    lock): closed -> open (consecutive failures or latency-outlier
+    ejection) -> half-open single probe -> closed or back open."""
+
+    __slots__ = ("state", "fails", "opened_at", "probing", "ewma",
+                 "samples", "ejections", "reopens", "reason")
+
+    def __init__(self):
+        self.state = "closed"
+        self.fails = 0          # consecutive transport failures
+        self.opened_at = 0.0
+        self.probing = False    # a half-open probe is in flight
+        self.ewma = 0.0         # per-forward latency EWMA (seconds)
+        self.samples = 0
+        self.ejections = 0      # times this replica's breaker opened
+        self.reopens = 0        # failed half-open probes
+        self.reason = None      # why it last opened ("errors"/"latency")
+
+    def snapshot(self):
+        return {"state": self.state, "ewma_s": round(self.ewma, 6),
+                "samples": self.samples, "fails": self.fails,
+                "ejections": self.ejections, "reopens": self.reopens,
+                "reason": self.reason}
 
 
 class RouteFailed(RuntimeError):
@@ -89,19 +146,47 @@ class FleetRouter:
     """
 
     def __init__(self, fleet, faults=None, default_timeout_s=120.0,
-                 retry_after_s=0.5, transport=None):
+                 retry_after_s=0.5, transport=None, breaker_fails=None,
+                 breaker_reset_s=None, breaker_outlier=None,
+                 breaker_min_latency_s=None, breaker_min_samples=None,
+                 eject_restart=False):
         self.fleet = fleet
         self._faults = faults
         self.default_timeout_s = float(default_timeout_s)
         self.retry_after_s = float(retry_after_s)
         self._transport = transport if transport is not None else _http_transport
+        # circuit-breaker tunables (env-overridable, arg wins):
+        #   fails     — consecutive transport failures that open it
+        #   reset_s   — open dwell before the half-open probe
+        #   outlier   — EWMA multiple of the fleet median that ejects
+        #   min_latency_s — absolute EWMA floor below which no ejection
+        #                   (a 2 ms replica in a 0.4 ms fleet is fine)
+        #   min_samples   — EWMA samples required before outlier checks
+        self.breaker_fails = int(breaker_fails if breaker_fails is not None
+                                 else _env_float("PSS_BREAKER_FAILS", 3))
+        self.breaker_reset_s = (
+            float(breaker_reset_s) if breaker_reset_s is not None
+            else _env_float("PSS_BREAKER_RESET_S", 2.0))
+        self.breaker_outlier = (
+            float(breaker_outlier) if breaker_outlier is not None
+            else _env_float("PSS_BREAKER_OUTLIER", 4.0))
+        self.breaker_min_latency_s = (
+            float(breaker_min_latency_s)
+            if breaker_min_latency_s is not None
+            else _env_float("PSS_BREAKER_MIN_LATENCY_S", 0.25))
+        self.breaker_min_samples = int(
+            breaker_min_samples if breaker_min_samples is not None
+            else _env_float("PSS_BREAKER_MIN_SAMPLES", 3))
+        self.eject_restart = bool(eject_restart)
         self._lock = threading.Lock()
+        self._breakers = {}      # replica id -> _Breaker
         self.routed = 0          # responses successfully returned
         self.forwarded = 0       # forward attempts (includes failovers)
         self.failovers = 0       # re-routes after a transport failure
         self.blackholed = 0      # route.blackhole shots absorbed
         self.kills_fired = 0     # replica.kill shots dispatched
         self.rejected = 0        # quorum / backpressure rejections
+        self.ejections = 0       # breaker opens (errors + latency)
         self.per_replica = {}    # replica id -> responses served
 
     # -- consistent routing ------------------------------------------------
@@ -110,20 +195,135 @@ class FleetRouter:
     def _score(h, replica_id):
         return hashlib.sha256(f"{h}:{replica_id}".encode()).digest()
 
-    def route(self, h, exclude=()):
+    def _allow_locked(self, b, now):
+        """May this replica take traffic right now?  Caller holds the
+        lock.  closed: yes.  open: only once ``breaker_reset_s`` has
+        elapsed (the probe path).  half-open: only while no probe is
+        already in flight."""
+        if b.state == "closed":
+            return True
+        if b.state == "open":
+            return (now - b.opened_at) >= self.breaker_reset_s \
+                and not b.probing
+        return not b.probing     # half_open
+
+    def route(self, h, exclude=(), probe=True):
         """The live replica that owns spec hash ``h``: rendezvous
-        hashing over ``fleet.endpoints()`` minus ``exclude``.  Returns
-        ``(replica_id, base_url)`` or None when nothing is routable."""
-        best = None
-        for rid, url in self.fleet.endpoints():
-            if rid in exclude:
-                continue
-            s = self._score(h, rid)
-            if best is None or s > best[0]:
-                best = (s, rid, url)
-        if best is None:
-            return None
-        return best[1], best[2]
+        hashing over ``fleet.endpoints()`` minus ``exclude`` minus
+        replicas whose circuit breaker is open (an open replica past
+        its reset window is admitted as a half-open PROBE — at most one
+        in flight, marked here only when ``probe`` and it actually won
+        the rendezvous).  Returns ``(replica_id, base_url)`` or None
+        when nothing is routable."""
+        now = time.monotonic()
+        with self._lock:
+            best = None
+            for rid, url in self.fleet.endpoints():
+                if rid in exclude:
+                    continue
+                b = self._breakers.get(rid)
+                if b is not None and not self._allow_locked(b, now):
+                    continue
+                s = self._score(h, rid)
+                if best is None or s > best[0]:
+                    best = (s, rid, url)
+            if best is None:
+                return None
+            if probe:
+                b = self._breakers.get(best[1])
+                if b is not None and b.state in ("open", "half_open"):
+                    b.state = "half_open"
+                    b.probing = True
+            return best[1], best[2]
+
+    # -- breaker bookkeeping ----------------------------------------------
+
+    def _breaker_states_locked(self):
+        return {rid: b.state for rid, b in self._breakers.items()}
+
+    def _record_success(self, rid, latency_s):
+        """Fold one successful forward's latency into the replica's
+        EWMA; close a half-open breaker; eject a latency outlier.
+        Returns True when this success OPENED the breaker (gray-failure
+        ejection) so the caller can hand the replica to the supervisor
+        outside the lock."""
+        ejected = False
+        with self._lock:
+            b = self._breakers.setdefault(rid, _Breaker())
+            b.fails = 0
+            b.probing = False
+            alpha = 0.3
+            if b.state in ("half_open", "open"):
+                # the probe answered: close, and RESET the EWMA to this
+                # fresh sample — the stale pre-ejection latency must not
+                # keep re-ejecting a replica that recovered (a probe
+                # that is itself still slow re-opens via the outlier
+                # check below, which is the reopen-on-still-sick path)
+                b.state = "closed"
+                b.reason = None
+                b.ewma = float(latency_s)
+            else:
+                b.ewma = (float(latency_s) if b.samples == 0
+                          else alpha * float(latency_s)
+                          + (1.0 - alpha) * b.ewma)
+            b.samples += 1
+            if b.state == "closed" and b.samples >= self.breaker_min_samples:
+                # latency-outlier ejection: this replica answers, but
+                # far slower than its peers — the gray failure /healthz
+                # cannot see.  Compare against the median EWMA of the
+                # OTHER closed replicas (an already-ejected peer must
+                # not drag the baseline up).
+                others = sorted(
+                    o.ewma for r2, o in self._breakers.items()
+                    if r2 != rid and o.samples > 0 and o.state == "closed")
+                if others:
+                    med = others[len(others) // 2]
+                    if (b.ewma > self.breaker_min_latency_s
+                            and b.ewma > self.breaker_outlier * med):
+                        b.state = "open"
+                        b.opened_at = time.monotonic()
+                        b.reason = "latency"
+                        b.ejections += 1
+                        self.ejections += 1
+                        ejected = True
+        if ejected and self.eject_restart:
+            # hand the gray replica to the supervisor: graceful SIGTERM
+            # restart (in-flight work finishes; a truly wedged child is
+            # SIGKILLed by the escalation) — routing already excludes it
+            restart = getattr(self.fleet, "restart_replica", None)
+            if restart is not None:
+                restart(rid)
+            else:
+                self.fleet.kill_replica(rid, signal.SIGTERM)
+        return ejected
+
+    def _clear_probe(self, rid):
+        """Release a half-open probe slot without recording an outcome
+        (the forward failed in a way that says nothing about the
+        replica — e.g. a client-side parse error)."""
+        with self._lock:
+            b = self._breakers.get(rid)
+            if b is not None:
+                b.probing = False
+
+    def _record_failure(self, rid):
+        """One transport failure: consecutive-failure counting opens the
+        breaker; a failed half-open probe reopens it immediately."""
+        with self._lock:
+            b = self._breakers.setdefault(rid, _Breaker())
+            probe_failed = b.probing or b.state == "half_open"
+            b.probing = False
+            b.fails += 1
+            if probe_failed:
+                b.state = "open"
+                b.opened_at = time.monotonic()
+                b.reopens += 1
+            elif b.state == "closed" and b.fails >= self.breaker_fails:
+                b.state = "open"
+                b.opened_at = time.monotonic()
+                b.reason = "errors"
+                b.ejections += 1
+                self.ejections += 1
 
     # -- request path ------------------------------------------------------
 
@@ -167,20 +367,30 @@ class FleetRouter:
         excluded = set()
         attempts = []
         while True:
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                # checked FIRST: an already-expired deadline fails
+                # immediately with zero transport calls, whatever the
+                # quorum/breaker state (pinned by a unit test)
+                raise RouteFailed(f"deadline exhausted for {h[:12]}",
+                                  attempts)
             if not self.fleet.has_quorum():
                 with self._lock:
                     self.rejected += 1
                 raise RequestRejected("fleet below quorum",
                                       self.retry_after_s)
-            remaining = t_end - time.monotonic()
-            if remaining <= 0:
-                raise RouteFailed(f"deadline exhausted for {h[:12]}",
-                                  attempts)
             picked = self.route(h, exclude=excluded)
             if picked is None:
                 if not excluded:
-                    raise RouteFailed(f"no live replica for {h[:12]}",
-                                      attempts)
+                    # nothing routable and nothing merely excluded-this-
+                    # request: either no live replica, or every live one
+                    # sits behind an open breaker — fail loudly with the
+                    # attempt trace and breaker states, never hang
+                    with self._lock:
+                        states = self._breaker_states_locked()
+                    raise RouteFailed(
+                        f"no routable replica for {h[:12]} "
+                        f"(breakers: {states or 'none'})", attempts)
                 # every live replica failed once: clear the exclusion,
                 # give restarts a beat to land, and try again
                 excluded.clear()
@@ -195,6 +405,7 @@ class FleetRouter:
             elif wait:
                 body["wait"] = remaining
             payload = json.dumps(body).encode()
+            t_fwd = time.monotonic()
             try:
                 if should_fire(self._faults, "route.blackhole",
                                token=str(rid)):
@@ -217,9 +428,33 @@ class FleetRouter:
                 # device execution.
                 attempts.append((rid, f"{type(err).__name__}: {err}"))
                 excluded.add(rid)
+                self._record_failure(rid)
                 with self._lock:
                     self.failovers += 1
                 continue
+            except BaseException:
+                # anything outside the failover tuple (http.client
+                # exceptions, a truncated-body ValueError from the
+                # transport's json parse) propagates to the caller —
+                # but must not strand a half-open probe flag, which
+                # would exclude the replica from routing forever
+                self._clear_probe(rid)
+                raise
+            if status >= 500:
+                # a replica answering every request with a fast 5xx is
+                # exactly as sick as one refusing connections: count it
+                # toward the breaker instead of poisoning the latency
+                # EWMA with near-zero "successes"
+                self._record_failure(rid)
+            elif status in (429, 503):
+                # backpressure says the replica is BUSY, not slow or
+                # broken: release any probe slot but keep the ~instant
+                # reject out of the EWMA — folding it in would collapse
+                # a shedding replica's baseline and make its healthy,
+                # actually-working peers look like latency outliers
+                self._clear_probe(rid)
+            else:
+                self._record_success(rid, time.monotonic() - t_fwd)
             with self._lock:
                 self.routed += 1
                 self.per_replica[rid] = self.per_replica.get(rid, 0) + 1
@@ -241,7 +476,7 @@ class FleetRouter:
             if remaining <= 0:
                 raise RouteFailed(f"deadline exhausted for GET {path}",
                                   attempts)
-            picked = self.route(h, exclude=excluded)
+            picked = self.route(h, exclude=excluded, probe=False)
             if picked is None:
                 raise RouteFailed(f"no live replica for GET {path}",
                                   attempts)
@@ -267,7 +502,10 @@ class FleetRouter:
                 "blackholed": self.blackholed,
                 "kills_fired": self.kills_fired,
                 "rejected": self.rejected,
+                "ejections": self.ejections,
                 "per_replica": dict(self.per_replica),
+                "breakers": {rid: b.snapshot()
+                             for rid, b in self._breakers.items()},
             }
 
 

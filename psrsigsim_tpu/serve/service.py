@@ -9,6 +9,21 @@ request dicts" and "padded device batches through precompiled programs":
   rejects with :class:`RequestRejected` carrying ``retry_after_s`` —
   the client is told to come back, never silently stalled.  Per-request
   deadlines expire queued work cleanly before it wastes device time.
+* **Deadline-aware load shedding** — admission also rejects a request
+  whose deadline is provably unmeetable: when the remaining budget is
+  smaller than the predicted queue wait (queue depth x the observed
+  per-request service-time EWMA), the request is shed at submit time
+  with a 429 instead of queuing work that can only expire.  The
+  ``Retry-After`` hint is LOAD-PROPORTIONAL: the estimated time for the
+  current queue to drain at the observed service rate (floored at the
+  static ``retry_after_s``), monotone in queue depth — client backoff
+  scales with actual congestion instead of a constant.
+* **Cache-tier degradation** — an ``OSError`` from a result-cache
+  commit (ENOSPC on the shared tier) degrades serving to PASS-THROUGH:
+  the computed result is still returned, the failure is counted loudly
+  (``cache_put_errors`` / ``cache_degraded`` in ``/metrics``), and the
+  flag clears on the next successful commit.  A full disk costs cache
+  hits, never requests.
 * **Coalescing** — a batcher thread groups compatible requests (same
   geometry hash) arriving within a short window, rounds the group up to
   a bucket width (padded rows replicate row 0 and are trimmed), and
@@ -157,8 +172,17 @@ class SimulationService:
         self._draining = False
         self.rejected = 0
         self.expired = 0
+        self.shed = 0             # rejected as deadline-unmeetable
         self.cache_hits = 0
         self.served = 0
+        self.cache_put_errors = 0  # commits lost to OSError (ENOSPC...)
+        self.cache_degraded = False  # pass-through mode (last put failed)
+        # observed per-request service time (compute seconds / batch
+        # rows), EWMA — the queue-wait predictor behind load shedding
+        # and the load-proportional Retry-After hint.  0.0 until the
+        # first batch lands (no shedding before there is evidence).
+        self._svc_ewma = 0.0
+        self._svc_alpha = 0.3
         # per-scenario-stack request counters (admitted submits,
         # including cache hits), keyed by the stack label ("base",
         # "scintillation+rfi", ...) — the /metrics traffic profile
@@ -234,11 +258,30 @@ class SimulationService:
             if should_fire(self._faults, "serve.reject", token=rid):
                 self.rejected += 1
                 raise RequestRejected("injected admission rejection",
-                                      self.retry_after_s)
-            if len(self._queue) >= self.max_queue:
+                                      self._retry_hint(len(self._queue)))
+            depth = len(self._queue)
+            if deadline_s is not None:
+                # deadline-aware shedding: reject NOW when the remaining
+                # budget is smaller than the predicted queue wait.  The
+                # EWMA divides batch compute by batch rows, so batching
+                # amortization is priced in at the HISTORICAL batch
+                # width — the estimate overshoots when coalescing
+                # suddenly widens (a shed then hit a request that was
+                # probably, not provably, doomed) and undershoots when
+                # it narrows (the _expire path still backstops those).
+                est_wait = depth * self._svc_ewma
+                if deadline_s <= 0 or est_wait > deadline_s:
+                    self.shed += 1
+                    self.rejected += 1
+                    raise RequestRejected(
+                        f"deadline {max(deadline_s, 0.0):.3f}s unmeetable: "
+                        f"predicted queue wait {est_wait:.3f}s "
+                        f"(depth {depth})", self._retry_hint(depth))
+            if depth >= self.max_queue:
                 self.rejected += 1
                 raise RequestRejected(
-                    f"queue full ({self.max_queue})", self.retry_after_s)
+                    f"queue full ({self.max_queue})",
+                    self._retry_hint(depth))
             req = _Request(rid, canonical, gh, deadline)
             self._requests[rid] = req
             self._queue.append(req)
@@ -246,6 +289,26 @@ class SimulationService:
             self._cond.notify_all()
         self.timers.add("enqueue", time.perf_counter() - t0)
         return rid, "queued"
+
+    def _retry_hint(self, depth):
+        """Load-proportional ``Retry-After``: the estimated seconds for
+        the CURRENT queue to drain at the observed per-request service
+        rate, floored at the static configured hint — monotone in queue
+        depth (pinned by a unit test), so client backoff scales with
+        actual congestion instead of a constant.  Before any batch has
+        executed (EWMA 0) the static floor applies."""
+        return max(self.retry_after_s, depth * self._svc_ewma)
+
+    def _observe_service_time(self, per_request_s):
+        """Fold one batch's observed per-request seconds into the
+        service-time EWMA (the shed/hint predictor).  Caller need not
+        hold the lock."""
+        with self._cond:
+            if self._svc_ewma == 0.0:
+                self._svc_ewma = float(per_request_s)
+            else:
+                self._svc_ewma = (self._svc_alpha * float(per_request_s)
+                                  + (1.0 - self._svc_alpha) * self._svc_ewma)
 
     def _coalesce(self, rid, deadline):
         """Coalesce onto an identical in-flight/completed request
@@ -327,14 +390,24 @@ class SimulationService:
             depth = len(self._queue)
             draining = self._draining
             served = self.served
+            shed = self.shed
+            degraded = self.cache_degraded
         reg = self.registry.stats()
         return {
             "ok": True,
             "replica_id": self.replica_id,
             "uptime_s": round(time.time() - self.started_at, 3),
             "queue_depth": depth,
+            # the autoscaler's load signals: depth is meaningless
+            # without its bound, and tail latency names overload that
+            # queue depth alone hides (slow device, big specs)
+            "max_queue": self.max_queue,
+            "request_p95_s": round(
+                self.timers.percentile("request", 0.95), 6),
             "draining": draining,
             "served": served,
+            "shed": shed,
+            "cache_degraded": degraded,
             "device_calls": reg["device_calls"],
             "programs": reg["programs"],
             "compile_counts": reg["compile_counts"],
@@ -355,7 +428,13 @@ class SimulationService:
                 "served": self.served,
                 "rejected": self.rejected,
                 "expired": self.expired,
+                "shed": self.shed,
                 "cache_hits": self.cache_hits,
+                "cache_put_errors": self.cache_put_errors,
+                "cache_degraded": self.cache_degraded,
+                "service_time_ewma_s": round(self._svc_ewma, 6),
+                "retry_after_hint_s": round(
+                    self._retry_hint(depth), 6),
                 "scenario_requests": dict(self.scenario_requests),
             }
         out["stages"] = self.timers.snapshot()
@@ -488,6 +567,7 @@ class SimulationService:
                                   sc=sc))
         compute_s = time.perf_counter() - t0
         self.timers.add("compute", compute_s)
+        self._observe_service_time(compute_s / len(batch))
         if stack is not None:
             # attribute this batch's device time to each enabled effect
             # (overlapping by design — excluded from the bottleneck pick)
@@ -499,7 +579,21 @@ class SimulationService:
         for i, r in enumerate(batch):
             arr = np.ascontiguousarray(out[i])
             if self.cache is not None:
-                self.cache.put(r.id, arr, meta={"geom": gh[:12]})
+                try:
+                    self.cache.put(r.id, arr, meta={"geom": gh[:12]})
+                    with self._cond:
+                        self.cache_degraded = False
+                    self.timers.gauge("cache_degraded", 0)
+                except OSError:
+                    # cache tier full/broken (ENOSPC): degrade to
+                    # pass-through — the request still completes with
+                    # its computed bytes, only caching is lost.  Loud:
+                    # counter + sticky gauge until a commit succeeds.
+                    with self._cond:
+                        self.cache_put_errors += 1
+                        self.cache_degraded = True
+                    self.timers.count("cache_put_error")
+                    self.timers.gauge("cache_degraded", 1)
             r.result = arr
             r.status = "done"
             r.done.set()

@@ -26,6 +26,30 @@ line on stdout:
     replayed index is consistent, every artifact re-hashes clean,
     exactly one committed artifact exists per hash with the expected
     bytes, and no claim markers or temp files leak.
+
+``--mode elastic``
+    The overload-survival acceptance pin (PR 11), four legs against one
+    solo byte-baseline: (1) **ramp** — a traffic burst at an autoscaled
+    fleet (min 1, max N) drives a scale-UP (queue-fraction signal), an
+    idle window drives the scale-DOWN (SIGTERM drain), and every
+    response across all three membership states is byte-identical to
+    the solo run with zero lost/torn cache commits; (2) **gray** — one
+    replica is made alive-but-slow (``replica.slow``); the router's
+    latency circuit breaker ejects it (slow responses bounded by the
+    injection budget — p99 is bounded during ejection) and, after the
+    fault clears, recovery arrives through the half-open probe;
+    (3) **enospc** — ``cache.enospc`` fails artifact commits; requests
+    still complete byte-identical (pass-through degradation, loud
+    ``cache_put_errors`` metric) with no leaked claims/tmps and a clean
+    verify; (4) **saturation** — a burst past queue capacity earns
+    429s carrying a positive (load-proportional) ``retry_after_s``,
+    tiny-deadline probes are SHED at admission as provably unmeetable,
+    and no generous-deadline accepted request expires in queue.
+
+``--mode elastic-bench``
+    config11_elastic: req/s and p99 at 1x/2x/4x of a nominal load for a
+    FIXED single-replica fleet vs an AUTOSCALED (min 1, max N) fleet,
+    429s counted, scale events reported.
 """
 
 import argparse
@@ -60,8 +84,10 @@ BASE_SPEC = {
 
 
 def request_spec(i):
-    """The i-th deterministic test request (distinct content hashes)."""
-    return dict(BASE_SPEC, seed=300 + i, dm=10.0 + 0.25 * i)
+    """The i-th deterministic test request (distinct content hashes —
+    the seed alone distinguishes specs; the dm wraps to stay inside the
+    validated range for the large bench index blocks)."""
+    return dict(BASE_SPEC, seed=300 + i, dm=10.0 + 0.25 * (i % 1000))
 
 
 def _profile_sha(resp):
@@ -344,12 +370,512 @@ def run_cache_stress(args):
 
 
 # ---------------------------------------------------------------------------
+# elastic overload survival (PR 11)
+# ---------------------------------------------------------------------------
+
+
+def _owner_of(spec, ids):
+    """The HRW owner of ``spec`` over replica ``ids`` (mirrors
+    FleetRouter._score) — lets the gray leg pick spec indices with a
+    KNOWN owner, so 'enough traffic routes to the slow replica' is a
+    property of the test, not luck."""
+    from psrsigsim_tpu.serve import canonicalize, spec_hash
+
+    h = spec_hash(canonicalize(spec))
+    return max(ids, key=lambda rid: hashlib.sha256(
+        f"{h}:{rid}".encode()).digest())
+
+
+def _drive_wave(router, indexed_specs, threads, deadline_s):
+    """Serve ``{index: spec}`` through the router from ``threads``
+    concurrent clients.  Returns (shas {index: sha}, latencies {index:
+    seconds}, rejections [(index, status, body)], errors [str]).
+    A 429/503 is recorded as a rejection, not an error (the saturation
+    leg asserts on them); any other non-done outcome is an error."""
+    shas, lats, rejections, errors = {}, {}, [], []
+
+    def one(i, spec):
+        t0 = time.perf_counter()
+        status, resp = router.submit(spec, deadline_s=deadline_s,
+                                     wait=True)
+        lat = time.perf_counter() - t0
+        if status in (429, 503):
+            return i, None, lat, (status, resp)
+        if status != 200 or resp.get("status") != "done":
+            raise RuntimeError(f"request {i}: HTTP {status} {resp}")
+        return i, _profile_sha(resp), lat, None
+
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        futs = [pool.submit(one, i, s) for i, s in indexed_specs.items()]
+        for fut in futs:
+            try:
+                i, sha, lat, rej = fut.result()
+                lats[i] = lat
+                if rej is not None:
+                    rejections.append((i, rej[0], rej[1]))
+                else:
+                    shas[i] = sha
+            except Exception as err:  # noqa: BLE001 - collected verdict
+                errors.append(f"{type(err).__name__}: {err}")
+    return shas, lats, rejections, errors
+
+
+def _audit_cache(cache_dir, ResultCache):
+    """Post-drain shared-tier audit: verify re-hash, leak scan."""
+    cache = ResultCache(cache_dir, verify=True)
+    out = {
+        "entries": len(cache),
+        "verified": cache.verified,
+        "lost_commits": cache.dropped,
+        "leaked_claims": os.listdir(os.path.join(cache_dir, "claims")),
+        "leaked_tmps": [n for n in os.listdir(
+            os.path.join(cache_dir, "results")) if n.endswith(".tmp")],
+    }
+    cache.close()
+    return out
+
+
+def _fetch_json(url, timeout=10):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_elastic(args):
+    from psrsigsim_tpu.runtime import FaultPlan
+    from psrsigsim_tpu.serve import FleetRouter, ReplicaFleet, ResultCache
+
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    warm_path = os.path.join(out_dir, "warm.json")
+    with open(warm_path, "w") as f:
+        json.dump(BASE_SPEC, f)
+    # ONE persistent compilation cache across every leg: the solo run
+    # pays the compile, every later replica (scale-ups included) warms
+    # from disk — exactly the property that makes scale-up cheap
+    compile_cache = os.path.join(out_dir, "compile_cache")
+
+    def mk_fleet(n, cache, **kw):
+        kw.setdefault("widths", (1,))
+        kw.setdefault("quorum", 1)
+        kw.setdefault("warmup_path", warm_path)
+        kw.setdefault("compile_cache_dir", compile_cache)
+        kw.setdefault("log_dir", os.path.join(out_dir, "logs"))
+        return ReplicaFleet(n, cache, **kw)
+
+    # -- spec layout (disjoint index ranges: entry accounting assumes
+    # every wave's specs are distinct) ------------------------------------
+    # up to three burst waves: the queue-depth signal is sampled by a
+    # periodic health poll, so one very fast burst can slip between
+    # polls — later bursts only fire if the scale-up has not triggered
+    bursts = [list(range(0, args.ramp_burst)),
+              list(range(30, 30 + args.ramp_burst)),
+              list(range(60, 60 + args.ramp_burst))]
+    ramp_b = list(range(90, 96))                        # scaled-up wave
+    ramp_c = list(range(100, 104))                      # post-scale-down
+    enospc_ix = list(range(110, 114))
+    # gray leg: pick indices whose HRW owner over ids {0,1} is KNOWN
+    slow_owned, fast_owned = [], []
+    i = 200
+    while len(slow_owned) < 5 or len(fast_owned) < 3:
+        o = _owner_of(request_spec(i), (0, 1))
+        if o == 1 and len(slow_owned) < 5:
+            slow_owned.append(i)
+        elif o == 0 and len(fast_owned) < 3:
+            fast_owned.append(i)
+        i += 1
+    gray_ix = sorted(slow_owned + fast_owned)
+    solo_ix = [i for b in bursts for i in b] + ramp_b + ramp_c \
+        + enospc_ix + gray_ix
+
+    # -- solo byte-baseline ----------------------------------------------
+    fleet = mk_fleet(1, os.path.join(out_dir, "solo_cache"))
+    fleet.start()
+    try:
+        router = FleetRouter(fleet)
+        solo, _, _, solo_errs = _drive_wave(
+            router, {i: request_spec(i) for i in solo_ix}, threads=2,
+            deadline_s=args.deadline)
+    finally:
+        fleet.drain()
+    if solo_errs or len(solo) != len(solo_ix):
+        return {"ok": False, "stage": "solo", "errors": solo_errs}
+
+    verdict = {"mode": "elastic", "ok": False}
+    mismatches = []
+
+    def check_bytes(shas):
+        mismatches.extend(i for i in shas if shas[i] != solo[i])
+
+    # -- leg 1: ramp (scale-up, scale-down, byte identity) ---------------
+    ramp_cache = os.path.join(out_dir, "ramp_cache")
+    # warm requests run in ~10 ms, so a burst drains in well under a
+    # second: the poll/control periods must sit INSIDE the burst window
+    # for the queue-depth signal to be observable at all
+    fleet = mk_fleet(
+        1, ramp_cache, max_queue=8, autoscale=True, min_replicas=1,
+        max_replicas=args.max_replicas, scale_up_queue_frac=0.1,
+        scale_down_queue_frac=0.02, scale_interval_s=0.05,
+        scale_up_cooldown_s=0.1, scale_down_cooldown_s=1.0,
+        health_interval_s=0.05)
+    fleet.start()
+    try:
+        # breakers effectively off: with wait=True a busy replica's
+        # transport latency includes queue wait, which is not a gray
+        # failure — this leg tests scaling, the gray leg tests breakers
+        router = FleetRouter(fleet, breaker_min_latency_s=1e9)
+        shas, rej, errs, driven = {}, [], [], 0
+        for burst in bursts:
+            s, _, r, e = _drive_wave(
+                router, {i: request_spec(i) for i in burst},
+                threads=6, deadline_s=args.deadline)
+            shas.update(s)
+            rej += r
+            errs += e
+            driven += len(burst)
+            # did this burst's queue depth order a scale-up?
+            t_end = time.monotonic() + 5.0
+            while time.monotonic() < t_end:
+                if fleet.pending_scale_up() or fleet.scale_events:
+                    break
+                time.sleep(0.1)
+            if fleet.pending_scale_up() or fleet.scale_events:
+                break
+        check_bytes(shas)
+        # wait out the scale-up replica's boot (warm from the shared
+        # compilation cache, but still a fresh process)
+        t_end = time.monotonic() + min(args.deadline, 120.0)
+        while fleet.healthy_count() < 2:
+            if time.monotonic() > t_end:
+                break
+            time.sleep(0.2)
+        scaled_up = fleet.healthy_count() >= 2
+        up_events = [e for e in fleet.scale_events if e["action"] == "up"]
+        # wave B spans the grown membership
+        shas_b, _, rej_b, errs_b = _drive_wave(
+            router, {i: request_spec(i) for i in ramp_b},
+            threads=4, deadline_s=args.deadline)
+        check_bytes(shas_b)
+        # idle window: the down threshold + cooldown retire the extra
+        # replica via SIGTERM drain
+        t_end = time.monotonic() + min(args.deadline, 120.0)
+        while fleet.active_count() > 1:
+            if time.monotonic() > t_end:
+                break
+            time.sleep(0.2)
+        scaled_down = fleet.active_count() == 1
+        down_events = [e for e in fleet.scale_events
+                       if e["action"] == "down"]
+        # wave C completes against the shrunk fleet
+        shas_c, _, rej_c, errs_c = _drive_wave(
+            router, {i: request_spec(i) for i in ramp_c},
+            threads=2, deadline_s=args.deadline)
+        check_bytes(shas_c)
+        ramp_errs = errs + errs_b + errs_c
+        ramp_rej = rej + rej_b + rej_c
+        ramp_done = len(shas) + len(shas_b) + len(shas_c)
+
+        # -- leg 4 rides the same fleet: saturation ----------------------
+        sat_ix = list(range(200, 200 + args.sat_burst))
+        sat_results = {"rejected": 0, "bad_hint": 0, "done": 0,
+                       "expired": 0, "max_hint": 0.0, "shed": 0}
+        sat_done_ix = []
+
+        def sat_one(i):
+            status, resp = router.submit(request_spec(i),
+                                         deadline_s=args.deadline,
+                                         wait=True)
+            return i, status, resp
+
+        def shed_probe(i):
+            # fired mid-flood, DIRECT to a replica, with a hopeless
+            # SERVICE deadline but a generous client wait (decoupled so
+            # the HTTP exchange itself has room): admission must shed it
+            # as unmeetable — or, degenerately, admit it on a
+            # momentarily-empty queue (honest prediction) where it then
+            # expires or completes
+            import urllib.error
+            import urllib.request
+
+            time.sleep(0.05)
+            _, url = fleet.endpoints()[0]
+            body = dict(request_spec(i), deadline_s=0.02, wait=10.0)
+            req = urllib.request.Request(
+                url + "/simulate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return i, r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return i, e.code, json.loads(e.read())
+
+        with ThreadPoolExecutor(max_workers=args.sat_burst + 3) as pool:
+            futs = [pool.submit(sat_one, i) for i in sat_ix]
+            futs += [pool.submit(shed_probe, 300 + k) for k in range(3)]
+            for fut in futs:
+                i, status, resp = fut.result()
+                if status in (429, 503):
+                    sat_results["rejected"] += 1
+                    hint = float(resp.get("retry_after_s", 0.0))
+                    sat_results["max_hint"] = max(sat_results["max_hint"],
+                                                  hint)
+                    if hint <= 0:
+                        sat_results["bad_hint"] += 1
+                    if "unmeetable" in str(resp.get("error", "")):
+                        sat_results["shed"] += 1
+                elif status == 200 and resp.get("status") == "done":
+                    sat_results["done"] += 1
+                    sat_done_ix.append(i)
+                elif (status in (410, 409)
+                      and resp.get("status") == "expired" and i >= 300):
+                    sat_results["expired"] += 1
+                else:
+                    ramp_errs.append(f"saturation {i}: {status} {resp}")
+    finally:
+        fleet.drain()
+    ramp_audit = _audit_cache(ramp_cache, ResultCache)
+    expected_entries = ramp_done + len(sat_done_ix)
+    verdict["ramp"] = {
+        "completed": ramp_done, "driven_bursts": driven,
+        "rejected_waves": len(ramp_rej),
+        "errors": ramp_errs, "scaled_up": scaled_up,
+        "scaled_down": scaled_down, "up_events": len(up_events),
+        "down_events": len(down_events),
+        "scale_events": fleet.scale_events,
+        "expected_entries": expected_entries, **ramp_audit}
+    verdict["saturation"] = sat_results
+    ramp_ok = (scaled_up and scaled_down and not ramp_errs
+               and not ramp_rej
+               and ramp_done == driven + len(ramp_b) + len(ramp_c)
+               and ramp_audit["lost_commits"] == 0
+               and ramp_audit["entries"] == expected_entries
+               and not ramp_audit["leaked_claims"]
+               and not ramp_audit["leaked_tmps"])
+    sat_ok = (sat_results["rejected"] >= 1
+              and sat_results["bad_hint"] == 0
+              and sat_results["shed"] + sat_results["expired"] >= 1
+              and sat_results["done"] >= 1)
+
+    # -- leg 2: gray failure (breaker ejection + half-open recovery) -----
+    gray_cache = os.path.join(out_dir, "gray_cache")
+    scratch = os.path.join(out_dir, "gray_scratch")
+    plan_path = os.path.join(out_dir, "gray_plan.json")
+    plan_spec = {"replica.slow": {"match": "1",
+                                  "delay_s": args.slow_delay,
+                                  "times": args.slow_times}}
+    with open(plan_path, "w") as f:
+        json.dump({"scratch_dir": scratch, "spec": plan_spec}, f)
+    plan = FaultPlan(scratch, plan_spec)   # shared markers: shot count
+    fleet = mk_fleet(2, gray_cache, fault_plan_path=plan_path)
+    fleet.start()
+    try:
+        router = FleetRouter(
+            fleet, breaker_outlier=3.0,
+            breaker_min_latency_s=args.slow_delay * 0.4,
+            breaker_min_samples=2, breaker_reset_s=1.0)
+        # fast replica first: the outlier median needs a baseline
+        order = fast_owned + slow_owned
+        shas_g, lats_g, _, errs_g = _drive_wave(
+            router, {i: request_spec(i) for i in order}, threads=2,
+            deadline_s=args.deadline)
+        check_bytes(shas_g)
+        st = router.stats()
+        ejected = st["ejections"] >= 1
+        slow_responses = sum(1 for v in lats_g.values()
+                             if v >= args.slow_delay * 0.9)
+        # recovery: re-submit an already-served slow-owned spec (cache
+        # hit — cheap) until the half-open probe lands on a replica
+        # whose fault budget is exhausted and the breaker CLOSES
+        recovered = False
+        t_end = time.monotonic() + args.deadline
+        while time.monotonic() < t_end:
+            router.submit(request_spec(slow_owned[0]),
+                          deadline_s=args.deadline, wait=True)
+            b = router.stats()["breakers"].get(1)
+            if (b is not None and b["state"] == "closed"
+                    and plan.shots_fired("replica.slow")
+                    >= args.slow_times):
+                recovered = True
+                break
+            time.sleep(0.4)
+        # the closed breaker takes traffic again, fast
+        t0 = time.perf_counter()
+        router.submit(request_spec(slow_owned[1]),
+                      deadline_s=args.deadline, wait=True)
+        recovered_fast = (time.perf_counter() - t0) < args.slow_delay * 0.5
+        gray_stats = router.stats()
+    finally:
+        fleet.drain()
+    gray_audit = _audit_cache(gray_cache, ResultCache)
+    verdict["gray"] = {
+        "completed": len(shas_g), "errors": errs_g, "ejected": ejected,
+        "ejections": gray_stats["ejections"],
+        "breakers": gray_stats["breakers"],
+        "slow_responses": slow_responses,
+        "slow_budget": args.slow_times,
+        "slow_owned": len(slow_owned),
+        "shots_fired": plan.shots_fired("replica.slow"),
+        "recovered": recovered, "recovered_fast": recovered_fast,
+        "p99_s": round(sorted(lats_g.values())[
+            max(0, int(0.99 * len(lats_g)) - 1)], 3) if lats_g else None,
+        **gray_audit}
+    # bounded p99 during ejection: the injection owns 5 spec indices,
+    # but ejection must cap slow responses at the shot budget — and the
+    # budget itself must not be fully spent inside the wave (the router
+    # stopped routing there)
+    gray_ok = (ejected and not errs_g and len(shas_g) == len(gray_ix)
+               and slow_responses <= args.slow_times
+               and slow_responses < len(slow_owned)
+               and recovered and recovered_fast
+               and gray_audit["lost_commits"] == 0
+               and not gray_audit["leaked_claims"]
+               and not gray_audit["leaked_tmps"])
+
+    # -- leg 3: ENOSPC pass-through degradation --------------------------
+    eno_cache = os.path.join(out_dir, "eno_cache")
+    eno_scratch = os.path.join(out_dir, "eno_scratch")
+    eno_plan_path = os.path.join(out_dir, "eno_plan.json")
+    eno_spec = {"cache.enospc": {"times": 2}}
+    with open(eno_plan_path, "w") as f:
+        json.dump({"scratch_dir": eno_scratch, "spec": eno_spec}, f)
+    eno_plan = FaultPlan(eno_scratch, eno_spec)
+    fleet = mk_fleet(1, eno_cache, fault_plan_path=eno_plan_path)
+    fleet.start()
+    try:
+        router = FleetRouter(fleet)
+        shas_e, _, _, errs_e = _drive_wave(
+            router, {i: request_spec(i) for i in enospc_ix}, threads=2,
+            deadline_s=args.deadline)
+        check_bytes(shas_e)
+        (_, url0), = fleet.endpoints()
+        metrics = _fetch_json(url0 + "/metrics")
+    finally:
+        fleet.drain()
+    eno_audit = _audit_cache(eno_cache, ResultCache)
+    fired = eno_plan.shots_fired("cache.enospc")
+    verdict["enospc"] = {
+        "completed": len(shas_e), "errors": errs_e,
+        "shots_fired": fired,
+        "cache_put_errors": metrics.get("cache_put_errors"),
+        "cache_write_errors": metrics.get("cache", {}).get("write_errors"),
+        "expected_entries": len(enospc_ix) - fired, **eno_audit}
+    eno_ok = (not errs_e and len(shas_e) == len(enospc_ix)
+              and fired >= 1
+              and metrics.get("cache_put_errors", 0) == fired
+              and eno_audit["entries"] == len(enospc_ix) - fired
+              and eno_audit["lost_commits"] == 0
+              and not eno_audit["leaked_claims"]
+              and not eno_audit["leaked_tmps"])
+
+    verdict["byte_identical"] = not mismatches
+    verdict["mismatches"] = mismatches
+    verdict["ramp_ok"] = ramp_ok
+    verdict["sat_ok"] = sat_ok
+    verdict["gray_ok"] = gray_ok
+    verdict["enospc_ok"] = eno_ok
+    verdict["ok"] = bool(ramp_ok and sat_ok and gray_ok and eno_ok
+                         and not mismatches)
+    return verdict
+
+
+def run_elastic_bench(args):
+    """config11_elastic: fixed single replica vs autoscaled fleet at
+    1x/2x/4x of a nominal concurrent load."""
+    from psrsigsim_tpu.serve import FleetRouter, ReplicaFleet
+
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    warm_path = os.path.join(out_dir, "warm.json")
+    with open(warm_path, "w") as f:
+        json.dump(BASE_SPEC, f)
+    compile_cache = os.path.join(out_dir, "compile_cache")
+    levels = (1, 2, 4)
+    base_r, base_t = args.requests, args.threads
+
+    def drive_levels(fleet, settle=False):
+        router = FleetRouter(fleet, breaker_min_latency_s=1e9)
+        out = {}
+        for m in levels:
+            ix = [10_000 * m + k for k in range(m * base_r)]
+            t0 = time.perf_counter()
+            _, lats, rej, errs = _drive_wave(
+                router, {i: request_spec(i) for i in ix},
+                threads=min(m * base_t, 16), deadline_s=args.deadline)
+            elapsed = time.perf_counter() - t0
+            done = len(lats) - len(rej)
+            vals = sorted(lats.values())
+            out[f"{m}x"] = {
+                "requests": len(ix), "done": done,
+                "rejected": len(rej), "errors": len(errs),
+                "active": fleet.active_count(),
+                "req_per_sec": round(done / elapsed, 2),
+                "p99_s": round(vals[max(0, int(0.99 * len(vals)) - 1)], 4)
+                if vals else None,
+            }
+            if settle:
+                # capacity ordered under THIS level's load serves the
+                # next level: let a pending scale-up replica finish
+                # booting before ramping further (boot >> wave length)
+                t_end = time.monotonic() + 60.0
+                while (fleet.pending_scale_up()
+                       and time.monotonic() < t_end):
+                    time.sleep(0.2)
+        return out
+
+    # the SAME tight queue bound for both fleets: at 4x the fixed fleet
+    # saturates (rejections counted), the autoscaled one adds capacity
+    max_queue = max(base_r, 8)
+    fleet = ReplicaFleet(
+        1, os.path.join(out_dir, "fixed_cache"), widths=(1,), quorum=1,
+        max_queue=max_queue, warmup_path=warm_path,
+        compile_cache_dir=compile_cache)
+    fleet.start()
+    try:
+        fixed = drive_levels(fleet)
+    finally:
+        fleet.drain()
+
+    fleet = ReplicaFleet(
+        1, os.path.join(out_dir, "elastic_cache"), widths=(1,), quorum=1,
+        max_queue=max_queue, warmup_path=warm_path,
+        compile_cache_dir=compile_cache, autoscale=True, min_replicas=1,
+        max_replicas=args.max_replicas, scale_up_queue_frac=0.1,
+        scale_down_queue_frac=0.02, scale_interval_s=0.05,
+        scale_up_cooldown_s=0.1, scale_down_cooldown_s=600.0,
+        health_interval_s=0.05)
+    fleet.start()
+    try:
+        elastic = drive_levels(fleet, settle=True)
+        events = list(fleet.scale_events)
+        max_active = max([e["active"] for e in events], default=1)
+    finally:
+        fleet.drain()
+
+    f4, e4 = fixed["4x"], elastic["4x"]
+    verdict = {
+        "mode": "elastic-bench", "levels": list(levels),
+        "base_requests": base_r, "base_threads": base_t,
+        "fixed": fixed, "elastic": elastic,
+        "scale_events": len(events), "max_active": max_active,
+        "elastic_over_fixed_4x": round(
+            e4["req_per_sec"] / f4["req_per_sec"], 2)
+        if f4["req_per_sec"] else None,
+        "ok": all(v["errors"] == 0 for v in fixed.values())
+        and all(v["errors"] == 0 for v in elastic.values()),
+    }
+    return verdict
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="chaos",
-                    choices=["chaos", "cache-stress", "stress-worker"])
+                    choices=["chaos", "cache-stress", "stress-worker",
+                             "elastic", "elastic-bench"])
     ap.add_argument("--out", required=True,
                     help="work dir (chaos/stress) or cache dir (worker)")
     ap.add_argument("--replicas", type=int, default=2)
@@ -366,6 +892,14 @@ def main(argv=None):
     ap.add_argument("--hashes", type=int, default=8)
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--plan", default=None)
+    # elastic / elastic-bench knobs
+    ap.add_argument("--max-replicas", type=int, default=2)
+    ap.add_argument("--ramp-burst", type=int, default=16)
+    ap.add_argument("--sat-burst", type=int, default=20)
+    ap.add_argument("--slow-delay", type=float, default=1.2,
+                    help="replica.slow injected latency (seconds)")
+    ap.add_argument("--slow-times", type=int, default=4,
+                    help="replica.slow shot budget")
     args = ap.parse_args(argv)
 
     # keep stdout clean for the one-line verdict protocol
@@ -375,6 +909,10 @@ def main(argv=None):
         verdict = run_chaos(args)
     elif args.mode == "cache-stress":
         verdict = run_cache_stress(args)
+    elif args.mode == "elastic":
+        verdict = run_elastic(args)
+    elif args.mode == "elastic-bench":
+        verdict = run_elastic_bench(args)
     else:
         verdict = run_stress_worker(args)
     print(json.dumps(verdict), file=real_stdout, flush=True)

@@ -427,6 +427,620 @@ class TestFleetRouter:
 
 
 # ---------------------------------------------------------------------------
+# circuit breakers: gray-failure ejection + half-open recovery (PR 11)
+# ---------------------------------------------------------------------------
+
+
+class _RestartStubFleet(_StubFleet):
+    def __init__(self, ids, quorum=1):
+        super().__init__(ids, quorum)
+        self.restarted = []
+
+    def restart_replica(self, i):
+        self.restarted.append(i)
+
+
+class TestCircuitBreaker:
+    def _slow_transport(self, slow_ids, slow_s=0.2, fast_s=0.001):
+        def transport(method, url, body, timeout):
+            rid = int(url.split("replica")[1].split("/")[0])
+            time.sleep(slow_s if rid in slow_ids else fast_s)
+            return 200, {"status": "done", "rid": rid, "profile": [[1.0]]}
+        return transport
+
+    def _router(self, fleet, transport, **kw):
+        kw.setdefault("breaker_outlier", 3.0)
+        kw.setdefault("breaker_min_latency_s", 0.05)
+        kw.setdefault("breaker_min_samples", 2)
+        kw.setdefault("breaker_reset_s", 0.3)
+        return FleetRouter(fleet, transport=transport, **kw)
+
+    def test_latency_outlier_is_ejected_and_handed_to_supervisor(self):
+        """An alive-but-slow replica (answers, just 200x slower than its
+        peers) must be ejected by the latency breaker — health polling
+        cannot see this — and handed to the supervisor for a graceful
+        restart when eject_restart is on."""
+        fleet = _RestartStubFleet([0, 1])
+        slow = {1}
+        r = self._router(fleet, self._slow_transport(slow),
+                         eject_restart=True)
+        for seed in range(16):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        st = r.stats()
+        assert st["ejections"] == 1
+        assert st["breakers"][1]["state"] == "open"
+        assert st["breakers"][1]["reason"] == "latency"
+        assert fleet.restarted == [1]
+        # while open, the slow replica's keys route to the healthy one:
+        # responses keep coming and none are slow
+        t0 = time.perf_counter()
+        for seed in range(16, 22):
+            status, resp = r.submit(dict(SPEC, seed=seed), deadline_s=10)
+            assert status == 200
+        assert time.perf_counter() - t0 < 0.15   # all fast-path
+
+    def test_half_open_probe_recovers_after_fault_clears(self):
+        fleet = _StubFleet([0, 1])
+        slow = {1}
+        r = self._router(fleet, self._slow_transport(slow))
+        for seed in range(16):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        assert r.stats()["breakers"][1]["state"] == "open"
+        slow.clear()                       # the gray failure heals
+        time.sleep(0.35)                   # past breaker_reset_s
+        for seed in range(16, 40):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        st = r.stats()
+        assert st["breakers"][1]["state"] == "closed"
+        assert st["per_replica"].get(1, 0) > 0   # taking traffic again
+        assert st["breakers"][1]["ejections"] == 1  # no flapping
+
+    def test_still_slow_probe_reopens(self):
+        """A half-open probe that is STILL slow must re-open the breaker
+        (reopen-on-still-sick), not hand the replica its keys back."""
+        fleet = _StubFleet([0, 1])
+        r = self._router(fleet, self._slow_transport({1}))
+        for seed in range(16):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        assert r.stats()["breakers"][1]["state"] == "open"
+        time.sleep(0.35)
+        for seed in range(16, 32):         # probes stay slow
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        st = r.stats()["breakers"][1]
+        assert st["state"] == "open" and st["ejections"] >= 2
+
+    def test_fast_5xx_counts_as_breaker_failure(self):
+        """Review fix: a replica answering every request with a fast
+        500 is as sick as one refusing connections — it must open the
+        breaker, not be recorded as a near-zero-latency success."""
+        fleet = _StubFleet([0, 1])
+
+        def transport(method, url, body, timeout):
+            rid = int(url.split("replica")[1].split("/")[0])
+            if rid == 1:
+                return 500, {"error": "internal"}
+            return 200, {"status": "done", "profile": [[1.0]]}
+
+        r = self._router(fleet, transport, breaker_fails=2,
+                         breaker_reset_s=60.0)
+        statuses = [r.submit(dict(SPEC, seed=s), deadline_s=10)[0]
+                    for s in range(24)]
+        st = r.stats()["breakers"][1]
+        assert st["state"] == "open" and st["reason"] == "errors"
+        # once open, the 500s stop reaching clients
+        assert 500 not in statuses[-6:]
+
+    def test_backpressure_replies_do_not_poison_the_ewma(self):
+        """Review fix: ~instant 429s from a saturated replica must stay
+        out of its latency EWMA — otherwise its healthy peer doing real
+        work looks like a latency outlier and gets ejected."""
+        fleet = _StubFleet([0, 1])
+
+        def transport(method, url, body, timeout):
+            rid = int(url.split("replica")[1].split("/")[0])
+            if rid == 1:
+                return 429, {"error": "queue full", "retry_after_s": 0.5}
+            time.sleep(0.01)          # replica 0 does real work
+            return 200, {"status": "done", "profile": [[1.0]]}
+
+        r = self._router(fleet, transport, breaker_outlier=3.0,
+                         breaker_min_latency_s=0.001,
+                         breaker_min_samples=2)
+        for s in range(24):
+            r.submit(dict(SPEC, seed=s), deadline_s=10)
+        st = r.stats()["breakers"]
+        assert st[0]["state"] == "closed"          # NOT ejected
+        assert st.get(1, {}).get("samples", 0) == 0  # 429s not sampled
+
+    def test_consecutive_failures_open_breaker(self):
+        fleet = _StubFleet([0, 1])
+        dead = {1}
+
+        def transport(method, url, body, timeout):
+            rid = int(url.split("replica")[1].split("/")[0])
+            if rid in dead:
+                raise ConnectionError("wedged socket")
+            return 200, {"status": "done", "profile": [[1.0]]}
+
+        r = self._router(fleet, transport, breaker_fails=2)
+        for seed in range(24):
+            status, _ = r.submit(dict(SPEC, seed=seed), deadline_s=10)
+            assert status == 200           # failover hides the failures
+        st = r.stats()
+        assert st["breakers"][1]["state"] == "open"
+        assert st["breakers"][1]["reason"] == "errors"
+        # once open, no further forwards hit the dead replica: failovers
+        # stop accumulating
+        before = r.stats()["failovers"]
+        for seed in range(24, 30):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        assert r.stats()["failovers"] == before
+
+
+class TestRouterEdgeCases:
+    def test_all_breakers_open_raises_route_failed_with_trace(self):
+        """Every live replica behind an open breaker -> RouteFailed with
+        the attempt trace and breaker states, promptly — never a hang
+        until the deadline."""
+        fleet = _StubFleet([0, 1])
+
+        def transport(method, url, body, timeout):
+            raise ConnectionError("always down")
+
+        r = FleetRouter(fleet, transport=transport, breaker_fails=1,
+                        breaker_reset_s=60.0)
+        t0 = time.perf_counter()
+        with pytest.raises(RouteFailed) as err:
+            r.submit(SPEC, deadline_s=30)
+        assert time.perf_counter() - t0 < 5.0
+        assert len(err.value.attempts) >= 2        # both replicas tried
+        assert "breakers" in str(err.value)
+
+    def test_expired_deadline_rejects_with_zero_transport_calls(self):
+        fleet = _StubFleet([0, 1])
+        calls = []
+
+        def transport(method, url, body, timeout):
+            calls.append(url)
+            return 200, {"status": "done", "profile": [[1.0]]}
+
+        r = FleetRouter(fleet, transport=transport)
+        with pytest.raises(RouteFailed):
+            r.submit(SPEC, deadline_s=-0.5)
+        with pytest.raises(RouteFailed):
+            r.submit(SPEC, deadline_s=0.0)
+        assert calls == []
+
+    def test_unexpected_transport_error_releases_probe_slot(self):
+        """Review fix: an exception OUTSIDE the failover tuple (e.g. a
+        truncated-body ValueError from the transport's json parse) must
+        not strand the half-open probing flag — the replica would be
+        unroutable forever."""
+        fleet = _StubFleet([0, 1])
+        mode = {"fail": True}
+
+        def transport(method, url, body, timeout):
+            rid = int(url.split("replica")[1].split("/")[0])
+            if rid == 1 and mode["fail"]:
+                raise ConnectionError("down")
+            if rid == 1 and mode.get("garble"):
+                raise ValueError("truncated body")
+            return 200, {"status": "done", "rid": rid, "profile": [[1.0]]}
+
+        r = FleetRouter(fleet, transport=transport, breaker_fails=1,
+                        breaker_reset_s=0.1)
+        # open replica 1's breaker
+        for seed in range(8):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        assert r.stats()["breakers"][1]["state"] == "open"
+        mode["fail"] = False
+        mode["garble"] = True
+        time.sleep(0.15)               # past reset: next hit is a probe
+        # drive until a probe routes to replica 1 and garbles
+        for seed in range(8, 40):
+            try:
+                r.submit(dict(SPEC, seed=seed), deadline_s=10)
+            except ValueError:
+                break
+        else:
+            pytest.fail("no probe reached the garbling replica")
+        # the probe slot is free: once healthy, the replica recovers
+        mode["garble"] = False
+        time.sleep(0.15)
+        for seed in range(40, 64):
+            r.submit(dict(SPEC, seed=seed), deadline_s=10)
+        st = r.stats()["breakers"][1]
+        assert st["state"] == "closed"
+
+    def test_all_replicas_excluded_then_deadline_bounds_the_retry(self):
+        """Transport fails everywhere with breakers effectively off: the
+        clear-and-retry loop must stay bounded by the deadline and raise
+        RouteFailed carrying the per-replica attempt trace."""
+        fleet = _StubFleet([0])
+
+        def transport(method, url, body, timeout):
+            raise ConnectionError("down")
+
+        r = FleetRouter(fleet, transport=transport, breaker_fails=10**6)
+        with pytest.raises(RouteFailed) as err:
+            r.submit(SPEC, deadline_s=0.3)
+        assert err.value.attempts
+        assert "0" in str(err.value.attempts[0][0])
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware shedding + load-proportional Retry-After (PR 11)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadShedding:
+    def _stalled_service(self, **kw):
+        from psrsigsim_tpu.serve import SimulationService
+
+        class NoBatch(SimulationService):
+            def _batch_loop(self):   # queue fills, nothing drains
+                return
+
+        kw.setdefault("cache_dir", None)
+        kw.setdefault("widths", (1,))
+        return NoBatch(**kw)
+
+    def test_retry_after_hint_monotone_in_queue_depth(self):
+        """The satellite pin: the Retry-After hint derives from queue
+        depth x observed service rate, floored at the static hint —
+        strictly monotone (non-decreasing) in depth."""
+        svc = self._stalled_service(max_queue=64, retry_after_s=0.5)
+        try:
+            svc._svc_ewma = 0.2
+            hints = [svc._retry_hint(d) for d in range(32)]
+            assert all(a <= b for a, b in zip(hints, hints[1:]))
+            assert hints[0] == 0.5            # floor at zero depth
+            assert hints[-1] == pytest.approx(31 * 0.2)
+            # before any observation the static floor applies everywhere
+            svc._svc_ewma = 0.0
+            assert [svc._retry_hint(d) for d in (0, 8, 64)] == [0.5] * 3
+        finally:
+            svc.close()
+
+    def test_queue_full_hint_scales_with_load(self):
+        svc = self._stalled_service(max_queue=3, retry_after_s=0.5)
+        try:
+            svc._svc_ewma = 0.4
+            for i in range(3):
+                rid, st = svc.submit(dict(SPEC, seed=i), deadline_s=60)
+                assert st == "queued"
+            with pytest.raises(RequestRejected) as err:
+                svc.submit(dict(SPEC, seed=99), deadline_s=60)
+            assert err.value.retry_after_s == pytest.approx(3 * 0.4)
+            assert err.value.retry_after_s > 0.5    # beyond the floor
+        finally:
+            svc.close()
+
+    def test_unmeetable_deadline_is_shed_at_admission(self):
+        svc = self._stalled_service(max_queue=8)
+        try:
+            svc._svc_ewma = 0.2
+            for i in range(4):
+                svc.submit(dict(SPEC, seed=i), deadline_s=60)
+            # predicted wait 4 * 0.2 = 0.8 s > 0.3 s budget: shed
+            with pytest.raises(RequestRejected) as err:
+                svc.submit(dict(SPEC, seed=50), deadline_s=0.3)
+            assert "unmeetable" in err.value.reason
+            assert svc.shed == 1
+            # a meetable deadline is admitted at the same depth
+            rid, st = svc.submit(dict(SPEC, seed=51), deadline_s=60)
+            assert st == "queued"
+            # with no evidence (EWMA 0) nothing positive is shed
+            svc._svc_ewma = 0.0
+            rid, st = svc.submit(dict(SPEC, seed=52), deadline_s=0.01)
+            assert st == "queued"
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# cache write-failure cleanup + ENOSPC degradation (PR 11)
+# ---------------------------------------------------------------------------
+
+
+class TestCacheWriteFailure:
+    def test_enospc_during_artifact_write_cleans_tmp_and_claim(
+            self, tmp_path):
+        """The satellite pin: an OSError mid-commit must unlink the tmp
+        and release the claim BEFORE re-raising — a failed writer never
+        wedges the per-hash single-writer claim until claim_timeout_s."""
+        d = str(tmp_path / "c")
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"cache.enospc": {"times": 1}})
+        # huge claim timeout: if the claim leaked, the re-put below
+        # would stall visibly instead of passing
+        c = ResultCache(d, faults=plan, claim_timeout_s=3600.0)
+        arr = np.ones((2, 4), np.float32)
+        with pytest.raises(OSError):
+            c.put("aa" * 32, arr)
+        assert c.write_errors == 1
+        assert not os.listdir(os.path.join(d, "claims"))
+        assert not [n for n in os.listdir(os.path.join(d, "results"))
+                    if n.endswith(".tmp")]
+        # the SAME writer retries immediately — no claim squatting
+        t0 = time.perf_counter()
+        rec = c.put("aa" * 32, arr)
+        assert time.perf_counter() - t0 < 5.0
+        assert rec["hash"] == "aa" * 32
+        got = c.get("aa" * 32)
+        assert got is not None and got.tobytes() == arr.tobytes()
+        c.close()
+
+    def test_enospc_during_journal_append_leaves_clean_state(
+            self, tmp_path):
+        """The journal variant: artifact renamed but unindexed (the same
+        benign state a SIGKILL between rename and append leaves) — no
+        torn journal, invisible to readers, recommitted cleanly."""
+        d = str(tmp_path / "c")
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"cache.enospc": {"times": 1, "at": "journal"}})
+        c = ResultCache(d, faults=plan, claim_timeout_s=3600.0)
+        arr = np.ones(4, np.float32)
+        with pytest.raises(OSError):
+            c.put("bb" * 32, arr)
+        assert c.get("bb" * 32) is None       # never indexed
+        assert not os.listdir(os.path.join(d, "claims"))
+        rec = c.put("bb" * 32, arr)           # recommit over the orphan
+        assert rec["hash"] == "bb" * 32
+        c.close()
+        # a fresh verify finds nothing torn
+        v = ResultCache(d, verify=True)
+        assert v.dropped == 0 and v.get("bb" * 32) is not None
+        v.close()
+
+    def test_write_errors_surface_in_stats(self, tmp_path):
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"cache.enospc": {"times": 2}})
+        c = ResultCache(str(tmp_path / "c"), faults=plan)
+        for h in ("cc" * 32, "dd" * 32):
+            with pytest.raises(OSError):
+                c.put(h, np.zeros(2, np.float32))
+        assert c.stats()["write_errors"] == 2
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic fleet: autoscaler control loop over stub replicas (PR 11)
+# ---------------------------------------------------------------------------
+
+
+#: a "replica" that speaks the one-line ready protocol then sleeps —
+#: real process lifecycle (spawn/SIGTERM/SIGKILL/restart) with no JAX
+_STUB_REPLICA = ("import json,sys,time;"
+                 "print(json.dumps({'ready': True, 'port': 1}));"
+                 "sys.stdout.flush(); time.sleep(300)")
+
+
+def _stub_fleet_cls():
+    from psrsigsim_tpu.serve import ReplicaFleet
+
+    class StubReplicaFleet(ReplicaFleet):
+        """Real fleet machinery over stub replica processes, with the
+        health poll answered locally (no sockets)."""
+
+        fake_depth = 0
+        poll_error = None
+
+        def _replica_cmd(self, i):
+            return [sys.executable, "-c", _STUB_REPLICA]
+
+        def _poll_health(self, url):
+            if self.poll_error is not None:
+                raise self.poll_error
+            return {"ok": True, "queue_depth": self.fake_depth,
+                    "max_queue": self.max_queue, "request_p95_s": 0.0}
+
+    return StubReplicaFleet
+
+
+def _wait_for(cond, timeout=30.0, period=0.05):
+    t_end = time.time() + timeout
+    while time.time() < t_end:
+        if cond():
+            return True
+        time.sleep(period)
+    return False
+
+
+class TestElasticFleet:
+    def test_hysteresis_validation(self, tmp_path):
+        from psrsigsim_tpu.serve import ReplicaFleet
+
+        with pytest.raises(ValueError):
+            ReplicaFleet(1, str(tmp_path), autoscale=True, min_replicas=1,
+                         max_replicas=2, scale_up_queue_frac=0.1,
+                         scale_down_queue_frac=0.1)   # up must be > down
+        with pytest.raises(ValueError):
+            ReplicaFleet(1, str(tmp_path), min_replicas=3, max_replicas=2)
+
+    def test_scale_up_then_down_with_lossless_retire(self, tmp_path):
+        """The control-loop pin: queue pressure spawns a replica (scale
+        event recorded, membership grows), idleness retires the NEWEST
+        one via SIGTERM after the longer down-cooldown, and the retiree
+        leaves routing before its drain signal."""
+        Fleet = _stub_fleet_cls()
+        fleet = Fleet(1, str(tmp_path / "c"), quorum=1, autoscale=True,
+                      min_replicas=1, max_replicas=2,
+                      scale_up_queue_frac=0.2, scale_down_queue_frac=0.05,
+                      scale_interval_s=0.05, scale_up_cooldown_s=0.05,
+                      scale_down_cooldown_s=0.2, health_interval_s=0.05,
+                      ready_timeout_s=30.0)
+        fleet.start()
+        try:
+            assert _wait_for(lambda: fleet.healthy_count() == 1)
+            Fleet.fake_depth = fleet.max_queue        # saturated queues
+            assert _wait_for(lambda: fleet.scale_events), fleet.health()
+            assert [e["action"] for e in fleet.scale_events] == ["up"]
+            assert _wait_for(lambda: fleet.healthy_count() == 2)
+            new_id = max(i for i, _ in fleet.endpoints())
+            Fleet.fake_depth = 0                      # idle
+            assert _wait_for(lambda: fleet.active_count() == 1), \
+                fleet.health()
+            ev = fleet.scale_events[-1]
+            assert ev["action"] == "down" and ev["replica"] == new_id
+            # the retiree is out of routing immediately
+            assert new_id not in [i for i, _ in fleet.endpoints()]
+            h = fleet.health()
+            assert h["autoscale"]["retired"] == [new_id]
+            # and never drops below min_replicas
+            assert _wait_for(lambda: fleet.active_count() == 1,
+                             timeout=1.0) and fleet.active_count() == 1
+        finally:
+            fleet.drain()
+
+    def test_bounded_by_max_replicas(self, tmp_path):
+        Fleet = _stub_fleet_cls()
+        fleet = Fleet(1, str(tmp_path / "c"), quorum=1, autoscale=True,
+                      min_replicas=1, max_replicas=2,
+                      scale_up_queue_frac=0.2, scale_down_queue_frac=0.05,
+                      scale_interval_s=0.05, scale_up_cooldown_s=0.05,
+                      scale_down_cooldown_s=60.0, health_interval_s=0.05,
+                      ready_timeout_s=30.0)
+        fleet.start()
+        try:
+            Fleet.fake_depth = fleet.max_queue
+            assert _wait_for(lambda: fleet.active_count() == 2)
+            time.sleep(0.5)            # sustained overload at the cap
+            assert fleet.active_count() == 2
+        finally:
+            fleet.drain()
+
+    def test_health_poll_timeout_sigkills_into_restart(self, tmp_path):
+        """The satellite pin for ReplicaFleet._health_loop: a replica
+        that stops answering /healthz (alive process, wedged listener)
+        is SIGKILLed after health_fail_after consecutive failures and
+        restarted by its supervisor."""
+        import urllib.error
+
+        Fleet = _stub_fleet_cls()
+        # deep restart budget: the poll keeps failing until the test
+        # clears it, and a slow CI box must not exhaust the policy and
+        # mark the replica failed before that
+        fleet = Fleet(1, str(tmp_path / "c"), quorum=1,
+                      health_interval_s=0.05, health_fail_after=3,
+                      ready_timeout_s=30.0,
+                      policy=RetryPolicy(max_attempts=100,
+                                         base_delay=0.05, max_delay=0.2))
+        fleet.start()
+        try:
+            assert _wait_for(lambda: fleet.healthy_count() == 1)
+            pid1 = fleet._sups[0].pid
+            Fleet.poll_error = urllib.error.URLError("wedged")
+            # 3 failed polls -> SIGKILL -> supervisor respawn
+            assert _wait_for(lambda: fleet._sups[0].restarts >= 1), \
+                fleet.health()
+            Fleet.poll_error = None
+            assert _wait_for(
+                lambda: fleet.healthy_count() == 1
+                and fleet._sups[0].pid not in (None, pid1))
+        finally:
+            fleet.drain()
+
+    def test_scale_down_never_retires_below_quorum(self, tmp_path):
+        """Review fix: an idle autoscaled fleet with quorum above
+        min_replicas must stop retiring AT the quorum — below it the
+        router rejects everything and the queue signal that would
+        trigger recovery can never form."""
+        Fleet = _stub_fleet_cls()
+        fleet = Fleet(3, str(tmp_path / "c"), quorum=2, autoscale=True,
+                      min_replicas=1, max_replicas=3,
+                      scale_up_queue_frac=0.5, scale_down_queue_frac=0.1,
+                      scale_interval_s=0.05, scale_up_cooldown_s=0.05,
+                      scale_down_cooldown_s=0.1, health_interval_s=0.05,
+                      ready_timeout_s=30.0)
+        fleet.start()
+        try:
+            assert _wait_for(lambda: fleet.healthy_count() == 3)
+            Fleet.fake_depth = 0                      # idle forever
+            assert _wait_for(lambda: fleet.active_count() == 2)
+            time.sleep(0.6)    # several down-cooldowns worth of idle
+            assert fleet.active_count() == 2          # stopped AT quorum
+            assert fleet.has_quorum()
+        finally:
+            fleet.drain()
+
+    def test_autoscale_default_quorum_tracks_min_replicas(self, tmp_path):
+        from psrsigsim_tpu.serve import ReplicaFleet
+
+        f = ReplicaFleet(4, str(tmp_path / "a"), autoscale=True,
+                         min_replicas=2, max_replicas=8)
+        assert f.quorum == 2           # majority of min, not of initial
+        f2 = ReplicaFleet(4, str(tmp_path / "b"))
+        assert f2.quorum == 3          # fixed fleet: majority of size
+
+    def test_dead_replica_contributes_no_capacity(self, tmp_path):
+        """Review fix: a crashed member in restart backoff must not
+        count as idle capacity — that would suppress the scale-up
+        signal exactly during a partial outage."""
+        Fleet = _stub_fleet_cls()
+        fleet = Fleet(2, str(tmp_path / "c"), quorum=1, max_queue=10,
+                      health_interval_s=0.05, ready_timeout_s=30.0,
+                      policy=RetryPolicy(max_attempts=3, base_delay=5.0,
+                                         max_delay=10.0))
+        fleet.start()
+        try:
+            Fleet.fake_depth = 4
+            # wait for real health samples (capacity alone also counts
+            # booting members), then kill one replica
+            assert _wait_for(
+                lambda: fleet.load_signal()["queue_depth"] == 8)
+            fleet._sups[1].kill()      # dies; restart is 5 s away
+            assert _wait_for(lambda: not fleet._sups[1].alive())
+            sig = fleet.load_signal()
+            assert sig["capacity"] == 10     # only the live replica
+            assert sig["queue_frac"] >= 0.4  # outage INCREASES the frac
+        finally:
+            fleet.drain()
+
+    def test_failed_member_is_pruned_from_active(self, tmp_path):
+        """Review fix: a member whose supervisor exhausted its restart
+        budget must be evicted from the active set, or it would hold an
+        `active < max_replicas` slot forever and cap scale-up."""
+        Fleet = _stub_fleet_cls()
+        fleet = Fleet(2, str(tmp_path / "c"), quorum=1, autoscale=True,
+                      min_replicas=1, max_replicas=2,
+                      scale_up_queue_frac=0.5, scale_down_queue_frac=0.1,
+                      scale_interval_s=0.05, scale_up_cooldown_s=60.0,
+                      scale_down_cooldown_s=60.0, health_interval_s=0.05,
+                      ready_timeout_s=30.0)
+        fleet.start()
+        try:
+            assert _wait_for(lambda: fleet.healthy_count() == 2)
+            sup = fleet._sups[1]
+            sup.stop()                 # simulate exhaustion terminally
+            sup.failed = True
+            assert _wait_for(lambda: fleet.active_count() == 1), \
+                fleet.health()
+            ev = fleet.scale_events[-1]
+            assert ev["action"] == "failed" and ev["replica"] == 1
+            assert fleet.health()["autoscale"]["retired"] == [1]
+        finally:
+            fleet.drain()
+
+    def test_load_signal_aggregates_health(self, tmp_path):
+        Fleet = _stub_fleet_cls()
+        fleet = Fleet(2, str(tmp_path / "c"), quorum=1, max_queue=10,
+                      health_interval_s=0.05, ready_timeout_s=30.0)
+        fleet.start()
+        try:
+            Fleet.fake_depth = 5
+            assert _wait_for(
+                lambda: fleet.load_signal()["queue_frac"] == 0.5), \
+                fleet.load_signal()
+            sig = fleet.load_signal()
+            assert sig["capacity"] == 20 and sig["queue_depth"] == 10
+            assert sig["active"] == 2
+        finally:
+            fleet.drain()
+            Fleet.fake_depth = 0
+
+
+# ---------------------------------------------------------------------------
 # subprocess proofs (PR-2 style)
 # ---------------------------------------------------------------------------
 
@@ -455,6 +1069,31 @@ class TestFleetProofs:
         assert rc == 0 and verdict["ok"], verdict
         assert verdict["dup_commits"] == {} and verdict["torn"] == []
         assert verdict["entries"] == verdict["expected_entries"]
+
+    @pytest.mark.slow
+    def test_elastic_overload_survival(self, tmp_path):
+        """The PR 11 acceptance pin: a traffic ramp drives scale-up
+        then scale-down with every response byte-identical to a solo
+        run and zero lost/torn commits across the membership changes;
+        an injected-slow replica is ejected by the circuit breaker
+        (slow responses bounded by the injection budget) and recovers
+        through the half-open probe; ENOSPC degrades the cache tier to
+        pass-through with no leaked claims/tmps; saturation earns
+        429s with positive Retry-After and admission sheds unmeetable
+        deadlines."""
+        verdict, rc = _run_runner(
+            ["--mode", "elastic", "--out", str(tmp_path / "e")],
+            timeout=560)
+        assert rc == 0 and verdict["ok"], verdict
+        assert verdict["byte_identical"] is True
+        assert verdict["ramp"]["scaled_up"] and verdict["ramp"]["scaled_down"]
+        assert verdict["ramp"]["lost_commits"] == 0
+        assert verdict["gray"]["ejected"] and verdict["gray"]["recovered"]
+        assert (verdict["gray"]["slow_responses"]
+                <= verdict["gray"]["slow_budget"])
+        assert verdict["enospc"]["completed"] == 4
+        assert verdict["saturation"]["rejected"] >= 1
+        assert verdict["saturation"]["bad_hint"] == 0
 
     @pytest.mark.slow
     def test_chaos_replica_kill_byte_identity(self, tmp_path):

@@ -301,13 +301,39 @@ class TestAdmission:
         finally:
             svc.close()
 
-    def test_deadline_expires_cleanly_without_device_time(self):
+    def test_expired_deadline_is_shed_at_admission(self):
+        """PR 11 load shedding supersedes queue-then-expire for a
+        deadline that is ALREADY hopeless at submit time: immediate
+        RequestRejected, no queue slot burnt, no device time."""
         svc = SimulationService(cache_dir=None, widths=(1,),
                                 batch_window_s=0.0)
         try:
             svc.warmup(SPEC)
             calls = svc.registry.device_calls
-            rid, _ = svc.submit(dict(SPEC, seed=501), deadline_s=-1.0)
+            with pytest.raises(RequestRejected) as err:
+                svc.submit(dict(SPEC, seed=501), deadline_s=-1.0)
+            assert "unmeetable" in err.value.reason
+            assert svc.registry.device_calls == calls
+            assert svc.shed == 1 and svc.expired == 0
+        finally:
+            svc.close()
+
+    def test_deadline_expires_cleanly_without_device_time(self):
+        """A deadline that was meetable at admission but lapses while
+        queued still expires cleanly (the _expire path): no device
+        time, terminal "expired" status."""
+        class Stalled(SimulationService):
+            def _take_batch(self):
+                batch = super()._take_batch()
+                if batch:
+                    time.sleep(0.3)    # hold past every batch deadline
+                return batch
+
+        svc = Stalled(cache_dir=None, widths=(1,), batch_window_s=0.0)
+        try:
+            svc.warmup(SPEC)
+            calls = svc.registry.device_calls
+            rid, _ = svc.submit(dict(SPEC, seed=501), deadline_s=0.05)
             with pytest.raises(RequestFailed) as err:
                 svc.result(rid, timeout=30)
             assert err.value.status == "expired"
