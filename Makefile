@@ -30,9 +30,15 @@
 # replica with half-open recovery, ENOSPC pass-through degradation,
 # and saturation 429/Retry-After + admission shedding
 # (bench.py elastic_smoke).
+# `make bench-dataset` is the dataset-factory gate: byte-identical
+# labeled corpora across chunk sizes {32,128,512}, SIGKILL-style
+# interruption resumed (with a changed chunk size) to byte-identical
+# shards, every label pinned bit-identical against the in-graph ground
+# truth, deterministic (seed, shard, epoch) shuffling, stage timers
+# naming the bottleneck (bench.py dataset_smoke).
 
 .PHONY: lint test test-faults bench-export bench-mc serve-smoke \
-	bench-scenarios fleet-smoke elastic-smoke
+	bench-scenarios fleet-smoke elastic-smoke bench-dataset
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -60,3 +66,6 @@ fleet-smoke:
 
 elastic-smoke:
 	JAX_PLATFORMS=cpu python bench.py --elastic-smoke
+
+bench-dataset:
+	JAX_PLATFORMS=cpu python bench.py --dataset-smoke

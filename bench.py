@@ -2205,6 +2205,288 @@ def time_io_encode(nchan=2048, nsub=20, nbin=2048):
     }
 
 
+# ---------------------------------------------------------------------------
+# Config 12: SEARCH-mode dataset factory (psrsigsim_tpu/datasets)
+# ---------------------------------------------------------------------------
+
+# the dataset bench spec: the SEARCH geometry of config 4 shrunk to CI
+# size, under an RFI + single-pulse scenario with dm / rfi_imp_snr
+# priors — every record carries a tile + mask + energies + injection
+# parameters, the full labeled-corpus schema
+_DATASET_BENCH_SPEC = {
+    "nchan": 4, "fcent_mhz": 1380.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "tobs_s": 0.1, "period_s": 0.005,
+    "smean_jy": 0.05, "seed": 3, "n_records": 512, "shards": 4,
+    "dm": 10.0, "scenarios": ["rfi", "single_pulse"],
+    "rfi_imp_prob": 0.25, "rfi_nb_prob": 0.25,
+    "priors": {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0},
+               "rfi_imp_snr": {"dist": "loguniform", "lo": 1.0,
+                               "hi": 50.0}},
+}
+
+# the smoke gate's spec: same schema, tiny tile (nsub 4, nsamp 4096) so
+# three full corpora + a resume proof fit a CI minute
+_DATASET_SMOKE_SPEC = dict(
+    _DATASET_BENCH_SPEC, nchan=2, tobs_s=0.02, seed=11,
+    priors={"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0},
+            "rfi_imp_snr": {"dist": "loguniform", "lo": 1.0, "hi": 50.0},
+            "sp_sigma": {"dist": "uniform", "lo": 0.1, "hi": 1.0}},
+)
+
+
+def cpu_reference_dataset_record(profiles, cfg, freqs, noise_norm, rng):
+    """One labeled training record the reference's way: host prior
+    sampling, the serial per-channel SEARCH observation
+    (:func:`cpu_reference_single_obs`), a serial per-pulse energy loop,
+    host RFI injection, and the labels assembled as host arrays — what a
+    dataset-generation loop over the reference package would execute per
+    record.  Statistically matched to the device record (same
+    distributions, same label schema), not bit-matched — this is the
+    throughput baseline, not a parity check."""
+    dm = rng.uniform(5.0, 20.0)
+    imp_snr = np.exp(rng.uniform(np.log(1.0), np.log(50.0)))
+    data = cpu_reference_single_obs(profiles, cfg, freqs, dm, noise_norm,
+                                    rng)
+    nchan, nsub, nph = data.shape[0], cfg.nsub, cfg.nph
+    # per-pulse energies (lognormal, unit mean), serial per-pulse loop
+    energies = np.exp(0.5 * rng.standard_normal(nsub) - 0.125)
+    for p in range(nsub):  # serial loop — reference-style per-pulse work
+        data[:, p * nph:(p + 1) * nph] *= energies[p]
+    # RFI: per-subint broadband bursts + per-channel tones, plus the mask
+    burst = rng.uniform(size=nsub) < 0.25
+    tone = rng.uniform(size=nchan) < 0.25
+    levels = (imp_snr * rng.exponential(size=nsub) * burst)[None, :] \
+        + (3.0 * rng.exponential(size=nchan) * tone)[:, None]
+    mask = burst[None, :] | tone[:, None]
+    for p in range(nsub):
+        data[:, p * nph:(p + 1) * nph] += (levels[:, p]
+                                           * noise_norm)[:, None]
+    params = np.asarray([dm, imp_snr], np.float32)
+    return data, mask.astype(np.uint8), energies.astype(np.float32), params
+
+
+def time_dataset(n_records=None, chunk=64):
+    """Config 12: labeled-dataset factory throughput — records/sec of
+    the full in-graph record program (prior sampling -> flat-tile SEARCH
+    observation with scenario effects -> truth labels) vs the NumPy
+    reference loop, plus the stage timers of a real journaled corpus
+    write (dispatch/fetch/encode/write — is the exit path device-bound
+    or disk-bound on THIS host?).
+
+    Device timing is the standard K-slope (K back-to-back chunks inside
+    one fori_loop, tile accumulator against DCE, fixed dispatch cost
+    cancelled — :func:`_timed_slope`)."""
+    import shutil
+    import tempfile
+
+    from psrsigsim_tpu.datasets import DatasetFactory
+    from psrsigsim_tpu.utils.rng import stage_key as _stage_key
+
+    if n_records is None:
+        n_records = int(os.environ.get("PSS_BENCH_DATASET_RECORDS", "512"))
+    fac = DatasetFactory(dict(_DATASET_BENCH_SPEC, n_records=n_records))
+    sampler = fac.sampler
+    cfg = sampler.cfg
+    width = sampler.chunk_width(chunk)
+    prog = sampler.program(width)
+    idxs = jnp.arange(width, dtype=jnp.int32)
+    tile_slot = len(sampler.field_layout()) - 1
+
+    @partial(jax.jit, static_argnames=("k",))
+    def run_k(root, k):
+        def body(i, acc):
+            r = jax.random.fold_in(root, i)
+            keys = jax.vmap(lambda j: _stage_key(r, "user", j))(idxs)
+            out = prog(keys, idxs, sampler._profiles_dev,
+                       sampler._freqs_dev, sampler._chan_ids_dev)
+            return acc + out[tile_slot]
+        return jax.lax.fori_loop(
+            0, k, body,
+            jnp.zeros((width, cfg.meta.nchan, cfg.nsamp), jnp.float32))
+
+    def call(k, seed):
+        return run_k(jax.random.key(seed), k)
+
+    slope, _, sdiag = _timed_slope(call, 2, 10)
+    t_record = slope / width
+    sync = _sync_probe(lambda s: call(10, s))
+
+    # a real journaled corpus write for the end-to-end rate + stage
+    # telemetry (device sampling + record encode + pwrite/fsync commits)
+    out_dir = tempfile.mkdtemp(prefix="pss_dataset_bench_")
+    try:
+        t0 = time.perf_counter()
+        res = fac.run(out_dir, chunk_size=chunk)
+        wall = time.perf_counter() - t0
+        snap = res["telemetry"]
+        stride = res["stride"]
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+
+    # the NumPy reference record loop (serial per-channel SEARCH obs +
+    # host labels), median-of-3
+    profiles64 = np.asarray(sampler._profiles_np, np.float64)
+    freqs = np.asarray(cfg.meta.dat_freq_mhz(), np.float64)
+    rng = np.random.default_rng(0)
+    cpu_reference_dataset_record(profiles64, cfg, freqs,
+                                 sampler.noise_norm, rng)  # warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cpu_reference_dataset_record(profiles64, cfg, freqs,
+                                     sampler.noise_norm, rng)
+        times.append(time.perf_counter() - t0)
+    t_cpu = float(np.median(times))
+
+    return {
+        "n_records": n_records,
+        "chunk_size": chunk,
+        "nchan": cfg.meta.nchan,
+        "nsub": cfg.nsub,
+        "nsamp": cfg.nsamp,
+        "record_bytes": stride,
+        "priors": list(sampler.param_names),
+        "scenarios": _DATASET_BENCH_SPEC["scenarios"],
+        "tpu_records_per_sec": round(1.0 / t_record, 2),
+        "e2e_records_per_sec": round(n_records / wall, 2),
+        "cpu_s_per_record": round(t_cpu, 6),
+        "speedup": round(t_cpu / t_record, 2),
+        "slope_ok": sdiag["slope_ok"],
+        **_sync_fields(sync),
+        "stage_timers": snap,
+        "bottleneck_stage": snap["bottleneck"],
+    }
+
+
+def dataset_smoke():
+    """Quick dataset-factory gate (``make bench-dataset``): a tiny
+    labeled corpus must (a) land byte-identical shards at chunk sizes
+    {32, 128, 512}, (b) resume an interrupted run — with a DIFFERENT
+    chunk size — to byte-identical shards, (c) carry every label pinned
+    bit-identical against the in-graph ground truth, (d) shuffle
+    deterministically as a pure function of (seed, shard, epoch), and
+    (e) report all four pipeline stage timers, naming the bottleneck.
+    Runs on whatever platform jax has (CPU in CI); asserts invariants,
+    not rates."""
+    import glob as _glob
+    import hashlib as _hashlib
+    import shutil
+    import tempfile
+
+    from psrsigsim_tpu.datasets import (DatasetFactory, DatasetReader,
+                                        shuffled_order)
+    from psrsigsim_tpu.mc.priors import parse_prior, sample_priors
+    from psrsigsim_tpu.runtime import StageTimers
+    from psrsigsim_tpu.scenarios.registry import (energy_truth,
+                                                  parse_stack,
+                                                  rfi_truth_mask)
+    from psrsigsim_tpu.utils.rng import stage_key as _stage_key
+
+    n_records = int(os.environ.get("PSS_BENCH_DATASET_RECORDS", "512"))
+    spec = dict(_DATASET_SMOKE_SPEC, n_records=n_records)
+    fac = DatasetFactory(spec)
+
+    def corpus_sha(d):
+        h = _hashlib.sha256()
+        for p in sorted(_glob.glob(os.path.join(d, "shard-*.records"))):
+            with open(p, "rb") as f:
+                h.update(f.read())
+        return h.hexdigest()
+
+    base = tempfile.mkdtemp(prefix="pss_dataset_smoke_")
+    try:
+        # (a) chunk-size invariance: byte-identical shards
+        shas, snap = [], None
+        for cs in (32, 128, 512):
+            tel = StageTimers()
+            DatasetFactory(spec).run(os.path.join(base, f"c{cs}"),
+                                     chunk_size=cs, telemetry=tel)
+            shas.append(corpus_sha(os.path.join(base, f"c{cs}")))
+            snap = tel.snapshot()
+        assert shas[0] == shas[1] == shas[2], (
+            f"corpus bytes differ across chunk sizes: {shas}")
+
+        # (b) interruption + changed-chunk-size resume -> byte-identical
+        rdir = os.path.join(base, "resume")
+        n_chunks = -(-n_records // 64)
+        stop_after = max(1, n_chunks // 2)
+        if n_chunks >= 2:
+            stopped = DatasetFactory(spec).run(
+                rdir, chunk_size=64, _stop_after_chunks=stop_after)
+            assert stopped is None, (
+                "interrupted run must not produce a result")
+        resumed = DatasetFactory(spec).run(rdir, chunk_size=96)
+        assert resumed["fingerprint"] == fac.fingerprint
+        assert corpus_sha(rdir) == shas[0], (
+            "resumed corpus differs from an uninterrupted run")
+
+        # (c) labels pinned against the in-graph ground truth (jitted
+        # oracle — a different program shape than the chunked sampler)
+        canonical = fac.canonical
+        stack = parse_stack(canonical["scenarios"])
+        priors = {k: parse_prior(s)
+                  for k, s in canonical["priors"].items()}
+        names = tuple(k for k in ("dm", "noise_scale")
+                      + tuple(stack.param_names()) if k in priors)
+        nsub = fac.sampler.cfg.nsub
+
+        @jax.jit
+        def oracle(key, idx):
+            p = sample_priors(priors, names, key, idx, stage="dataset")
+            sc = {n: p.get(n, jnp.float32(canonical[n]))
+                  for n in stack.param_names()}
+            return (rfi_truth_mask(key, stack, sc, nsub=nsub,
+                                   chan_ids=jnp.arange(
+                                       canonical["nchan"])
+                                   ).astype(jnp.uint8),
+                    energy_truth(key, stack, sc, nsub=nsub),
+                    jnp.stack([p[n] for n in names]),
+                    jnp.stack([sc[n] for n in stack.param_names()]))
+
+        reader = DatasetReader(os.path.join(base, "c128"))
+        root = jax.random.key(canonical["seed"])
+        any_mask = False
+        for i in range(0, n_records, max(1, n_records // 32)):
+            rec = reader.read_index(i)
+            mask, en, params, scn = jax.device_get(
+                oracle(_stage_key(root, "user", i), jnp.int32(i)))
+            assert (rec["rfi_mask"] == mask).all(), f"record {i} mask"
+            assert (rec["energies"] == en).all(), f"record {i} energies"
+            assert (rec["params"] == params).all(), f"record {i} params"
+            assert (rec["scenario_params"] == scn).all(), (
+                f"record {i} scenario_params")
+            any_mask = any_mask or mask.any()
+        assert any_mask, "no contaminated record in the pinned sample"
+
+        # (d) deterministic shuffle: pure function, permutation, golden
+        assert shuffled_order(64, 5, 2, 9) == shuffled_order(64, 5, 2, 9)
+        assert sorted(shuffled_order(64, 5, 2, 9)) == list(range(64))
+        assert shuffled_order(8, 1, 0, 0) == [6, 1, 5, 0, 7, 4, 3, 2], (
+            "shuffled_order drifted from its golden pin")
+
+        # (e) stage timers all present and live
+        for stage in ("dispatch", "fetch", "encode", "write"):
+            assert snap[f"{stage}_calls"] > 0, f"stage {stage} never ran"
+        assert snap["records_count"] == n_records
+        assert snap["write_bytes"] > 0 and snap["fetch_bytes"] > 0
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    device_stages_s = snap["dispatch_s"] + snap["fetch_s"]
+    host_stages_s = snap["encode_s"] + snap["write_s"]
+    return {
+        "metric": "dataset_smoke",
+        "n_records": n_records,
+        "chunk_sizes": [32, 128, 512],
+        "fingerprint": fac.fingerprint,
+        "stage_timers": snap,
+        "bottleneck_stage": snap["bottleneck"],
+        # is the exit path device-bound (sampler/compute) on this host?
+        "device_bound": bool(device_stages_s >= host_stages_s),
+        "ok": True,
+    }
+
+
 _REAL_STDOUT = sys.stdout
 
 # ---------------------------------------------------------------------------
@@ -2233,6 +2515,8 @@ _COMPACT_FIELDS = (
     ("machinery_speedup", "mspd", 0),
     ("tpu_obs_per_sec", "obs_s", 1),
     ("tpu_trials_per_sec", "trl_s", 1),
+    ("tpu_records_per_sec", "rec_s", 1),
+    ("e2e_records_per_sec", "erec_s", 1),
     ("e2e_packed_obs_per_sec", "pobs_s", 1),
     ("packed_over_perfile", "pvf", 2),
     ("batched_req_per_sec", "req_s", 1),
@@ -2367,6 +2651,14 @@ def main():
         # saturation 429/Retry-After gates
         with contextlib.redirect_stdout(sys.stderr):
             result = elastic_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
+    if "--dataset-smoke" in sys.argv[1:]:
+        # `make bench-dataset`: chunk-size byte identity + changed-chunk
+        # resume identity + label ground-truth pins + deterministic
+        # shuffle + stage timers
+        with contextlib.redirect_stdout(sys.stderr):
+            result = dataset_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
     if "--scenario-smoke" in sys.argv[1:]:
@@ -2564,6 +2856,16 @@ def _main():
         f"req/s (p99 {ela['elastic_p99_s_4x']:.2f}s) -> "
         f"{ela['elastic_over_fixed']:.2f}x; scale_events "
         f"{ela['scale_events']}, max_active {ela['max_active']}")
+    _checkpoint(detail)
+
+    # --- config 12: SEARCH-mode dataset factory -------------------------
+    ds = time_dataset()
+    detail["config12_dataset"] = ds
+    log(f"config12_dataset: device {ds['tpu_records_per_sec']:.1f} "
+        f"records/s (e2e journaled {ds['e2e_records_per_sec']:.1f} "
+        f"records/s, {ds['record_bytes']} B/record) vs cpu "
+        f"{1/ds['cpu_s_per_record']:.2f} records/s -> "
+        f"{ds['speedup']:.1f}x (bottleneck: {ds['bottleneck_stage']})")
     _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------

@@ -112,14 +112,17 @@ def main(argv=None):
         print("psrlint: clean")
 
     if args.trace_check:
-        from .trace_check import run_serve_trace_check, run_trace_check
+        from .trace_check import (run_dataset_trace_check,
+                                  run_serve_trace_check, run_trace_check)
 
         results = run_trace_check()
         ok = sum(1 for r in results if r.status == "ok")
         exempt = sum(1 for r in results if r.status == "exempt")
         serve_ok = len(run_serve_trace_check())
+        dataset_ok = len(run_dataset_trace_check())
         print(f"trace-check: {ok} ops traced clean, {exempt} exempt, "
-              f"{serve_ok} serving bucket program(s) traced clean")
+              f"{serve_ok} serving bucket program(s) and "
+              f"{dataset_ok} dataset record program(s) traced clean")
 
     return status
 

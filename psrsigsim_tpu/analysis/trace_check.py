@@ -19,7 +19,8 @@ fail here regardless of what the linter thought.  Runs on CPU
 from __future__ import annotations
 
 __all__ = ["EXEMPT", "probe_specs", "run_trace_check",
-           "run_serve_trace_check", "ProbeResult"]
+           "run_serve_trace_check", "run_dataset_trace_check",
+           "ProbeResult"]
 
 from dataclasses import dataclass
 
@@ -179,6 +180,46 @@ def run_serve_trace_check(widths=(1, 8)):
         _check_one(f"serve_width_bucket[w={w}]", fn, (keys, z, z, z))
         results.append(ProbeResult(f"serve_width_bucket[w={w}]", "ok"))
     return results
+
+
+def run_dataset_trace_check():
+    """Probe the dataset factory's record sampler: the labeled-record
+    body (prior draws on the ``"dataset"`` stage + the SEARCH pipeline
+    with scenario effects + the registry truth labels) must
+    ``make_jaxpr``/``eval_shape`` and hold a stable jit cache (retrace
+    count == 1) over a canonical tiny spec — the dynamic twin of the
+    record program's shared-registry single-build contract, run where
+    the linter gate runs so a trace-unsafe edit to the sampler, the
+    SEARCH scenario hooks, or a registry truth function fails CI before
+    it reaches a corpus run.
+    """
+    import numpy as np
+
+    import jax
+
+    from ..datasets.sampler import RecordSampler
+    from ..datasets.spec import canonicalize
+
+    canonical = canonicalize({
+        "nchan": 2, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+        "sample_rate_mhz": 0.2048, "tobs_s": 0.02, "period_s": 0.005,
+        "smean_jy": 0.05, "seed": 0, "n_records": 8, "dm": 10.0,
+        "scenarios": ["scintillation", "rfi", "single_pulse"],
+        "priors": {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0}},
+    })
+    sampler = RecordSampler(canonical)
+    ctx = sampler._program_context()
+    prof = jax.numpy.asarray(sampler._profiles_np)
+    freqs = jax.numpy.asarray(
+        np.asarray(sampler.cfg.meta.dat_freq_mhz(), np.float32))
+    chan_ids = jax.numpy.arange(sampler.cfg.meta.nchan)
+
+    def record(key, idx):
+        return ctx._record(key, idx, prof, freqs, chan_ids)
+
+    _check_one("dataset_record", record,
+               (jax.random.key(0), jax.numpy.int32(0)))
+    return [ProbeResult("dataset_record", "ok")]
 
 
 def run_trace_check(symbols=None):

@@ -1,0 +1,44 @@
+"""SEARCH-mode dataset factory: labeled ML training corpora at sampler
+roofline.
+
+The scenario-diversity flywheel named by the paper's "search-training
+dataset generation" workload: scenario-randomized SEARCH observations
+stream straight from device buffers into sharded, shuffled,
+label-carrying training records — raw SEARCH tile + RFI contamination
+mask + injection/scenario parameters + per-pulse energies — with no
+PSRFITS round-trip.  Every effect registered with the scenario engine
+(:mod:`psrsigsim_tpu.scenarios`) immediately becomes a labeled class in
+the corpus: its ground-truth hooks are recomputed in the SAME fused
+program as the injection.
+
+- :mod:`~psrsigsim_tpu.datasets.spec` — strict canonical dataset specs
+  with a fingerprint hash (the corpus identity).
+- :mod:`~psrsigsim_tpu.datasets.sampler` — the chunked device sampler:
+  per-record priors on the ``"dataset"`` RNG stage + the flat-tile
+  SEARCH pipeline + registry truth labels, sharded over the mesh.
+- :mod:`~psrsigsim_tpu.datasets.writer` — dependency-free
+  length-prefixed record shards with per-shard JSON indexes,
+  deterministic ``(seed, shard, epoch)`` read-time shuffling, and a
+  self-describing reader.
+- :mod:`~psrsigsim_tpu.datasets.factory` — the crash-safe run loop:
+  journal/cursor commits (SIGKILL-resumable, byte-identical even across
+  changed chunk sizes), stage telemetry, manifest fingerprint guard.
+"""
+
+from .factory import DatasetFactory, DatasetManifestError
+from .sampler import RecordSampler
+from .spec import (DatasetSpecError, RECORD_FORMAT_VERSION, canonicalize,
+                   fingerprint_hash)
+from .writer import DatasetReader, shuffled_order
+
+__all__ = [
+    "DatasetFactory",
+    "DatasetManifestError",
+    "DatasetReader",
+    "DatasetSpecError",
+    "RECORD_FORMAT_VERSION",
+    "RecordSampler",
+    "canonicalize",
+    "fingerprint_hash",
+    "shuffled_order",
+]

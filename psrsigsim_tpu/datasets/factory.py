@@ -1,0 +1,340 @@
+"""The streaming dataset factory: spec -> sharded labeled corpus on disk.
+
+Orchestrates the three pieces around the export engine's journal/commit
+discipline (PR-2, shared loader
+:func:`~psrsigsim_tpu.runtime.supervisor.load_chunk_journal`):
+
+1. **dispatch/fetch** — chunks of records run on device through the
+   :class:`~psrsigsim_tpu.datasets.sampler.RecordSampler` with one chunk
+   of dispatch-ahead (the device computes chunk N+1 while the host
+   encodes/commits chunk N);
+2. **encode** — each fetched record becomes its exact on-disk bytes
+   (:func:`~psrsigsim_tpu.datasets.writer.encode_record`) straight from
+   the device buffers — no PSRFITS round-trip, no intermediate files;
+3. **commit** — positional ``pwrite`` into the record shards, ``fsync``
+   of exactly the touched shards, THEN one fsync'd journal line
+   (``{"e": "chunk", "start", "count", "sha"}`` — sha256 of the chunk's
+   record bytes), THEN the atomic cursor.  A SIGKILL at any point loses
+   at most one uncommitted chunk; because slots are positional and
+   records are pure functions of ``(seed, index)``, a resumed run —
+   even with a DIFFERENT chunk size — lands byte-identical shards
+   (tests/dataset_runner.py proves it through the ``dataset.kill``
+   fault point).
+
+The corpus identity is the spec fingerprint
+(:func:`~psrsigsim_tpu.datasets.spec.fingerprint_hash`); the manifest
+guard refuses to resume a directory written under a different one, the
+same contract as the export/study manifests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+import jax
+
+from .sampler import RecordSampler
+from .spec import (RECORD_FORMAT_VERSION, canonicalize, fingerprint_hash)
+from .writer import DatasetReader, ShardWriter, encode_record
+
+__all__ = ["DatasetFactory", "DatasetManifestError"]
+
+_MANIFEST_NAME = "dataset_manifest.json"
+_JOURNAL_NAME = "dataset_journal.jsonl"
+_CURSOR_NAME = "dataset_cursor.json"
+
+
+class DatasetManifestError(RuntimeError):
+    """``resume=True`` against an out_dir written by a DIFFERENT corpus.
+
+    Carries the per-field disagreement (mirrors
+    :class:`~psrsigsim_tpu.mc.StudyManifestError` /
+    :class:`~psrsigsim_tpu.io.export.ExportManifestError`)."""
+
+    def __init__(self, out_dir, mismatches):
+        self.out_dir = out_dir
+        self.mismatches = dict(mismatches)
+        lines = [f"  - {k}: out_dir has {v[0]!r}, this run has {v[1]!r}"
+                 for k, v in sorted(self.mismatches.items())]
+        super().__init__(
+            f"out_dir {out_dir} holds a dataset with different parameters; "
+            "resuming would silently mix two corpora.  Differing fields:\n"
+            + "\n".join(lines)
+            + "\nUse a fresh out_dir, or resume=False to overwrite.")
+
+
+class DatasetFactory:
+    """One corpus run: validate the spec, compile the sampler, stream
+    labeled records into sharded files with crash-safe commits.
+
+    Parameters
+    ----------
+    spec : dict
+        A dataset spec (:func:`datasets.spec.canonicalize` rules).
+    mesh : jax.sharding.Mesh, optional
+        Forwarded to the sampler.
+    """
+
+    def __init__(self, spec, mesh=None):
+        self.canonical = canonicalize(spec)
+        self.fingerprint = fingerprint_hash(self.canonical)
+        self.sampler = RecordSampler(self.canonical, mesh=mesh)
+        self.n_records = self.sampler.n_records
+        self.n_shards = int(self.canonical["shards"])
+
+    # -- manifest -----------------------------------------------------------
+
+    def manifest_fields(self):
+        """The resume-guarded manifest body: the fingerprint plus the
+        human-auditable summary (spec, schema, shard layout)."""
+        return {
+            "kind": "dataset",
+            "fingerprint": self.fingerprint,
+            "record_format": RECORD_FORMAT_VERSION,
+            "spec": self.canonical,
+            "n_records": self.n_records,
+            "shards": self.n_shards,
+            "fields": [{"name": n, "dtype": d, "shape": list(s)}
+                       for n, d, s in self.sampler.field_layout()],
+        }
+
+    def _check_manifest(self, out_dir, resume):
+        from ..io.export import _atomic_write_json
+
+        fp = self.manifest_fields()
+        path = os.path.join(out_dir, _MANIFEST_NAME)
+        old = None
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    old = json.load(f)
+            except json.JSONDecodeError:
+                if resume:
+                    raise RuntimeError(
+                        f"manifest {path} exists but is unreadable; cannot "
+                        "prove the out_dir holds this corpus. Use "
+                        "resume=False to overwrite, or a fresh out_dir.")
+        if old is not None and resume:
+            mismatches = {k: (old.get(k), fp[k])
+                          for k in fp if old.get(k) != fp[k]}
+            if mismatches:
+                raise DatasetManifestError(out_dir, mismatches)
+            merged = {**{k: v for k, v in old.items() if k not in fp}, **fp}
+        else:
+            merged = dict(fp)
+        _atomic_write_json(path, merged, indent=1)
+
+    # -- the run ------------------------------------------------------------
+
+    def run(self, out_dir, chunk_size=256, resume=True, telemetry=None,
+            progress=None, faults=None, _stop_after_chunks=None):
+        """Write (or resume) the corpus; returns a summary dict.
+
+        Args:
+            out_dir: corpus directory (shards + indexes + manifest +
+                journal live here).
+            chunk_size: records per compiled dispatch (rounds up to the
+                mesh's obs-shard count; every value yields byte-identical
+                shards — pinned by tests).
+            resume: skip chunks the journal records as committed
+                (verified by sha256 against the shard bytes); ``False``
+                starts clean.
+            telemetry: optional
+                :class:`~psrsigsim_tpu.runtime.StageTimers` (canonical
+                dispatch/fetch/encode/write stages + a ``records``
+                counter and per-stage byte totals).
+            progress: optional callable ``progress(done, total)``.
+            faults: optional
+                :class:`~psrsigsim_tpu.runtime.FaultPlan` (tests only;
+                arms the ``dataset.kill`` point — SIGKILL right after a
+                chunk's journal commit).
+            _stop_after_chunks: TESTING hook — stop cleanly after N
+                fresh chunk commits (an interrupted run without a
+                subprocess); returns None.
+
+        Returns: ``{"fingerprint", "n_records", "shards", "stride",
+        "commits", "resumed_chunks", "telemetry"}``.
+        """
+        import time as _time
+
+        from ..runtime.faults import crash_process
+        from ..runtime.supervisor import load_chunk_journal
+        from ..runtime.telemetry import StageTimers
+
+        if telemetry is None:
+            telemetry = StageTimers()
+        sampler = self.sampler
+        layout = sampler.field_layout()
+        names = [n for n, _, _ in layout]
+        width = sampler.chunk_width(chunk_size)
+
+        os.makedirs(out_dir, exist_ok=True)
+        self._check_manifest(out_dir, resume)
+        journal_path = os.path.join(out_dir, _JOURNAL_NAME)
+        cursor_path = os.path.join(out_dir, _CURSOR_NAME)
+        if not resume:
+            # the overwrite path must remove EVERY previous corpus byte,
+            # not just the journal: a prior corpus with more records or
+            # more shards would otherwise leave stale tail bytes inside
+            # (and stale shard/index files beside) the new one, breaking
+            # the equal-fingerprints-mean-byte-identical-corpora contract
+            import glob as _glob
+
+            stale = [journal_path, cursor_path]
+            stale += _glob.glob(os.path.join(out_dir, "shard-*.records"))
+            stale += _glob.glob(os.path.join(out_dir,
+                                             "shard-*.index.json"))
+            for p in stale:
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+            done = {}
+        else:
+            done = load_chunk_journal(journal_path)
+
+        writer = ShardWriter(out_dir, self.n_records, self.n_shards,
+                             layout, RECORD_FORMAT_VERSION)
+        # indexes are a pure function of the spec: write them first (and
+        # on every resume — idempotent, atomic), so even a corpus killed
+        # mid-run has self-describing shards
+        writer.write_indexes(self.fingerprint, self.canonical["seed"])
+        journal_f = open(journal_path, "a")
+
+        commits = 0
+        resumed = 0
+        done_records = 0
+
+        def _report(count):
+            nonlocal done_records
+            done_records += count
+            if progress is not None:
+                progress(done_records, self.n_records)
+
+        def _chunk_sha_on_disk(start, count):
+            """Re-hash a journaled chunk's record bytes from the shards
+            (resume verification — never trust existence alone)."""
+            h = hashlib.sha256()
+            for i in range(start, start + count):
+                buf = writer.read_record_bytes(i)
+                if len(buf) != writer.stride:
+                    return None
+                h.update(buf)
+            return h.hexdigest()
+
+        def _dispatch(start):
+            t0 = _time.perf_counter()
+            dev = sampler.dispatch(start, width)
+            telemetry.add("dispatch", _time.perf_counter() - t0)
+            return dev
+
+        def _fetch(dev):
+            t0 = _time.perf_counter()
+            host = jax.device_get(dev)
+            telemetry.add("fetch", _time.perf_counter() - t0,
+                          nbytes=sum(np.asarray(a).nbytes for a in host))
+            return host
+
+        def _encode(start, count, host):
+            t0 = _time.perf_counter()
+            recs = []
+            for j in range(count):
+                arrays = {n: host[f][j] for f, n in enumerate(names)}
+                recs.append(encode_record(start + j, arrays, layout,
+                                          RECORD_FORMAT_VERSION))
+            telemetry.add("encode", _time.perf_counter() - t0)
+            return recs
+
+        def _commit(start, recs):
+            """Durable record of one fresh chunk: record bytes land
+            positionally in their shards (pwrite), the touched shards
+            fsync, THEN the journal line, THEN the atomic cursor — a
+            SIGKILL leaves either a committed record or none."""
+            nonlocal commits
+            t0 = _time.perf_counter()
+            touched = set()
+            h = hashlib.sha256()
+            for j, rb in enumerate(recs):
+                touched.add(writer.write_record(start + j, rb))
+                h.update(rb)
+            writer.fsync(touched)
+            rec = {"e": "chunk", "start": int(start),
+                   "count": len(recs), "sha": h.hexdigest()}
+            journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
+            journal_f.flush()
+            os.fsync(journal_f.fileno())
+            from ..io.export import _atomic_write_json
+
+            commits += 1
+            _atomic_write_json(cursor_path, {
+                "commits": commits, "journal_bytes": journal_f.tell()})
+            telemetry.add("write", _time.perf_counter() - t0,
+                          nbytes=len(recs) * writer.stride)
+            telemetry.count("records", len(recs))
+            if faults is not None:
+                cfg = faults.config("dataset.kill")
+                if cfg is not None:
+                    after = cfg.get("after_start")
+                    if after is None or after == start:
+                        if faults.fire("dataset.kill",
+                                       token=f"start={start}"):
+                            crash_process()
+
+        stopped = False
+        try:
+            inflight = []  # [(start, count, device futures)]
+
+            def _drain_one():
+                nonlocal stopped
+                s0, c0, dev = inflight.pop(0)
+                host = _fetch(dev)
+                _commit(s0, _encode(s0, c0, host))
+                _report(c0)
+                if (_stop_after_chunks is not None
+                        and commits >= _stop_after_chunks):
+                    stopped = True
+
+            for start in range(0, self.n_records, width):
+                count = min(width, self.n_records - start)
+                rec = done.get(start)
+                if (rec is not None and int(rec.get("count", -1)) == count
+                        and _chunk_sha_on_disk(start, count)
+                        == rec.get("sha")):
+                    resumed += 1
+                    _report(count)
+                    continue
+                inflight.append((start, count, _dispatch(start)))
+                if len(inflight) > 1:
+                    _drain_one()
+                    if stopped:
+                        return None
+            while inflight:
+                _drain_one()
+                if stopped:
+                    return None
+        finally:
+            journal_f.close()
+            writer.close()
+
+        return {
+            "fingerprint": self.fingerprint,
+            "n_records": self.n_records,
+            "shards": self.n_shards,
+            "stride": writer.stride,
+            "commits": commits,
+            "resumed_chunks": resumed,
+            "telemetry": telemetry.snapshot(),
+        }
+
+    def reader(self, out_dir):
+        """A :class:`~psrsigsim_tpu.datasets.writer.DatasetReader` over a
+        finished corpus, fingerprint-checked against this factory."""
+        r = DatasetReader(out_dir)
+        if r.fingerprint != self.fingerprint:
+            raise DatasetManifestError(
+                out_dir, {"fingerprint": (r.fingerprint, self.fingerprint)})
+        return r

@@ -1,0 +1,287 @@
+"""The chunked device sampler: (seed, record index) -> labeled record.
+
+One training record is ONE in-graph program evaluation composed from
+pieces that already exist elsewhere in the repo:
+
+* prior draws — :func:`psrsigsim_tpu.mc.priors.sample_priors` on the
+  dedicated ``"dataset"`` RNG stage, keyed per record exactly like the
+  study engine keys per trial;
+* the SEARCH-mode observation — :func:`simulate.single_pipeline` with
+  its flat-tile chi-squared field draws (the >20 Gsamp/s sampler path)
+  and the scenario stack's SEARCH hooks;
+* the labels — the scenario registry's truth functions
+  (:func:`~psrsigsim_tpu.scenarios.registry.rfi_truth_mask`,
+  :func:`~psrsigsim_tpu.scenarios.registry.energy_truth`), recomputed
+  in the SAME fused program from the same keys/params as the injection,
+  plus the sampled prior values themselves (the injection parameters).
+
+A chunk of records is vmapped and sharded over the ``(obs, chan)`` mesh
+(records over ``obs``, channels over ``chan``); programs resolve through
+the shared registry (:mod:`psrsigsim_tpu.runtime.programs`) keyed by a
+spec-derived digest, so two factories over the same physics share one
+compiled program per chunk width.
+
+Reproducibility: record ``i``'s key is ``stage_key(key(seed), "user",
+i)`` — the ensemble's observation-key derivation — so every quantity in
+a record depends only on ``(seed, global record index)``: bit-identical
+across chunk sizes, shard counts, and mesh shapes (pinned by
+tests/test_datasets.py), which is what makes the factory's kill/resume
+byte-identity possible at all.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..mc.priors import parse_prior, sample_priors
+from ..parallel.mesh import CHAN_AXIS, OBS_AXIS, make_mesh
+from ..simulate.pipeline import single_pipeline
+from ..scenarios.registry import energy_truth, rfi_truth_mask
+from ..utils.rng import stage_key
+from .spec import (PRIORS_FIELD, build_search_geometry, canonical_json,
+                   knob_order, scenario_stack)
+
+try:  # jax >= 0.6 stable API, else the experimental home
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["RecordSampler"]
+
+
+class RecordSampler:
+    """Compiled chunked record programs for one canonical dataset spec.
+
+    Parameters
+    ----------
+    canonical : dict
+        A canonical spec from :func:`datasets.spec.canonicalize`.
+    mesh : jax.sharding.Mesh, optional
+        Records shard over ``obs``, channels over ``chan`` (default
+        :func:`~psrsigsim_tpu.parallel.make_mesh`).
+    """
+
+    def __init__(self, canonical, mesh=None):
+        self.canonical = dict(canonical)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.stack = scenario_stack(canonical)
+        self.cfg, profiles_np, self.noise_norm = build_search_geometry(
+            canonical)
+        self._profiles_np = np.ascontiguousarray(profiles_np, np.float32)
+        self.seed = int(canonical["seed"])
+        self.n_records = int(canonical["n_records"])
+
+        #: canonical knob order (base knobs then enabled stack params)
+        self.knobs = knob_order(canonical)
+        #: the prior-varied subset, in knob order — the record's
+        #: ``params`` label columns and the prior key-fold slot order
+        self.priors = {k: parse_prior(s)
+                       for k, s in canonical[PRIORS_FIELD].items()}
+        self.param_names = tuple(k for k in self.knobs if k in self.priors)
+        #: fixed per-corpus value of every knob (spec fields; a prior
+        #: supersedes per record)
+        self.fixed = {k: float(canonical[k]) for k in self.knobs}
+
+        nchan = self.cfg.meta.nchan
+        n_chan_shards = self.mesh.shape[CHAN_AXIS]
+        if nchan % n_chan_shards:
+            raise ValueError(
+                f"nchan={nchan} must be divisible by the chan mesh axis "
+                f"({n_chan_shards})")
+
+        self._has_rfi = (self.stack is not None
+                         and "rfi" in self.stack.names())
+        self._has_sp = (self.stack is not None
+                        and "single_pulse" in self.stack.names())
+
+        chan_sh = NamedSharding(self.mesh, P(CHAN_AXIS))
+        self._profiles_dev = jax.device_put(
+            self._profiles_np, NamedSharding(self.mesh, P(CHAN_AXIS, None)))
+        self._freqs_dev = jax.device_put(
+            np.asarray(self.cfg.meta.dat_freq_mhz(), np.float32), chan_sh)
+        self._chan_ids_dev = jax.device_put(np.arange(nchan), chan_sh)
+        self._obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
+        self._programs = {}  # chunk width -> jitted sharded program
+
+        # program-shaping digest for the shared registry: the canonical
+        # spec minus the purely-traced/corpus-shape fields (seed ->
+        # keys, n_records -> indices, shards -> host-side layout), plus
+        # the geometry statics the builder derived (nsub/nph/nsamp bake
+        # into the program as shapes)
+        digest_src = {k: v for k, v in self.canonical.items()
+                      if k not in ("seed", "n_records", "shards")}
+        digest_src["_geometry"] = [int(self.cfg.nsub), int(self.cfg.nph),
+                                   int(self.cfg.nsamp),
+                                   float(self.noise_norm)]
+        self._program_digest = hashlib.sha256(
+            json.dumps(digest_src, sort_keys=True).encode()).hexdigest()
+
+    # -- record schema ------------------------------------------------------
+
+    def field_layout(self):
+        """Ordered per-record field descriptions ``(name, dtype, shape)``
+        — the single schema source the writer's byte layout, the shard
+        index files, and the reader all derive from.  Label fields of a
+        disabled effect are absent, not zero-filled: the corpus schema
+        grows exactly with the scenario stack."""
+        cfg = self.cfg
+        fields = [("params", "<f4", (len(self.param_names),)),
+                  ("scenario_params", "<f4",
+                   (len(self.stack.param_names())
+                    if self.stack is not None else 0,))]
+        if self._has_sp:
+            fields.append(("energies", "<f4", (cfg.nsub,)))
+        if self._has_rfi:
+            fields.append(("rfi_mask", "|u1", (cfg.meta.nchan, cfg.nsub)))
+        fields.append(("tile", "<f4", (cfg.meta.nchan, cfg.nsamp)))
+        return fields
+
+    # -- the in-graph record ------------------------------------------------
+
+    _CONTEXT_FIELDS = ("cfg", "stack", "priors", "param_names", "knobs",
+                       "fixed", "noise_norm", "_has_rfi", "_has_sp")
+
+    def _program_context(self):
+        """A slim stand-in for ``self`` holding only what the record
+        program reads — registry-cached closures must not pin the
+        sampler's device buffers and program dict for the process
+        lifetime (the study engine's ``_program_context`` rationale)."""
+        ctx = object.__new__(type(self))
+        for name in self._CONTEXT_FIELDS:
+            setattr(ctx, name, getattr(self, name))
+        return ctx
+
+    def _record(self, key, idx, profiles, freqs, chan_ids):
+        """One labeled record: prior draws -> SEARCH observation with
+        scenario effects -> truth labels, all from ``key`` alone."""
+        cfg = self.cfg
+        p = sample_priors(self.priors, self.param_names, key, idx,
+                          stage="dataset")
+        vals = {k: p.get(k, jnp.float32(self.fixed[k])) for k in self.knobs}
+        # base * scale in float32, exactly as the MC trial multiplies —
+        # the record stream must match an equal-parameter observation
+        nn = jnp.float32(self.noise_norm) * vals["noise_scale"]
+        sc = None
+        if self.stack is not None:
+            sc = {n: vals[n] for n in self.stack.param_names()}
+        tile = single_pipeline(key, vals["dm"], nn, profiles, cfg,
+                               freqs=freqs, chan_ids=chan_ids,
+                               scenario=self.stack, scenario_params=sc)
+        out = {"tile": tile,
+               "params": (jnp.stack([p[n] for n in self.param_names])
+                          if self.param_names
+                          else jnp.zeros((0,), jnp.float32)),
+               "scenario_params": (
+                   jnp.stack([sc[n] for n in self.stack.param_names()])
+                   if sc else jnp.zeros((0,), jnp.float32))}
+        if self._has_sp:
+            out["energies"] = energy_truth(key, self.stack, sc,
+                                           nsub=cfg.nsub)
+        if self._has_rfi:
+            # uint8 on device so the fetched bytes ARE the record bytes
+            out["rfi_mask"] = rfi_truth_mask(
+                key, self.stack, sc, nsub=cfg.nsub,
+                chan_ids=chan_ids).astype(jnp.uint8)
+        return tuple(out[name] for name, _, _ in self.field_layout())
+
+    # -- compiled chunk programs --------------------------------------------
+
+    def _out_specs(self):
+        specs = []
+        for name, _, shape in self.field_layout():
+            if name in ("tile", "rfi_mask"):
+                specs.append(P(OBS_AXIS, CHAN_AXIS, None))
+            else:
+                specs.append(P(OBS_AXIS, None))
+        return tuple(specs)
+
+    def program(self, width):
+        """One jitted sharded program per chunk width, resolved through
+        the shared registry (the per-instance dict stays as the
+        lock-free fast path)."""
+        prog = self._programs.get(width)
+        if prog is not None:
+            return prog
+        mesh = self.mesh
+        ctx = self._program_context()
+
+        def _local(keys, idxs, profiles, freqs, chan_ids):
+            return jax.vmap(
+                lambda k, i: ctx._record(k, i, profiles, freqs, chan_ids)
+            )(keys, idxs)
+
+        # check_rep=False: energies/params are computed identically on
+        # every chan shard (pure functions of the record key) — honestly
+        # replicated, but the rep checker cannot prove it through the
+        # vmapped draws (the study engine's situation exactly)
+        def _build():
+            return jax.jit(shard_map(
+                _local,
+                mesh=mesh,
+                in_specs=(P(OBS_AXIS), P(OBS_AXIS), P(CHAN_AXIS, None),
+                          P(CHAN_AXIS), P(CHAN_AXIS)),
+                out_specs=self._out_specs(),
+                check_rep=False,
+            ))
+
+        from ..runtime.programs import global_registry, trace_env_key
+
+        prog = global_registry().get_or_build(
+            ("dataset_records", self._program_digest, mesh, int(width),
+             trace_env_key()),
+            _build)
+        self._programs[width] = prog
+        return prog
+
+    def chunk_width(self, chunk_size):
+        """Round a requested chunk size up to the obs-shard count (the
+        ensemble's padding rule)."""
+        n_shards = self.mesh.shape[OBS_AXIS]
+        chunk_size = min(int(chunk_size), self.n_records)
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be positive")
+        return chunk_size + (-chunk_size) % n_shards
+
+    def dispatch(self, start, width):
+        """Launch one chunk asynchronously; returns device futures for
+        records ``start..start+width`` (indices wrap modulo
+        ``n_records``; the caller trims the wrapped tail)."""
+        idx = (start + np.arange(width)) % self.n_records
+        root = jax.random.key(self.seed)
+        idx_j = jnp.asarray(idx, jnp.int32)
+        keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx_j)
+        return self.program(width)(
+            jax.device_put(keys, self._obs_sharding),
+            jax.device_put(idx_j, self._obs_sharding),
+            self._profiles_dev, self._freqs_dev, self._chan_ids_dev)
+
+    # -- host-side conveniences ---------------------------------------------
+
+    def record_host(self, index):
+        """One record as a host dict (label-integrity tests and the
+        add-an-effect tutorial): the same program path as the factory,
+        width = one obs-shard round."""
+        width = self.chunk_width(1)
+        out = jax.device_get(self.dispatch(int(index), width))
+        return {name: np.asarray(a[0])
+                for (name, _, _), a in zip(self.field_layout(), out)}
+
+    def describe(self):
+        """JSON-able sampler summary (manifests, shard indexes)."""
+        return {
+            "knobs": list(self.knobs),
+            "param_names": list(self.param_names),
+            "scenarios": (self.stack.describe()
+                          if self.stack is not None else []),
+            "fields": [{"name": n, "dtype": d, "shape": list(s)}
+                       for n, d, s in self.field_layout()],
+            "program_digest": self._program_digest,
+            "canonical": canonical_json(self.canonical),
+        }

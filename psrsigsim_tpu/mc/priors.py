@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Prior", "Fixed", "Uniform", "LogUniform", "Normal", "Grid",
-           "Choice", "parse_prior"]
+           "Choice", "parse_prior", "sample_priors"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,6 +233,35 @@ _DISTS = {
     "choice": lambda s: Choice(tuple(s["values"]),
                                tuple(s["probs"]) if s.get("probs") else None),
 }
+
+
+def sample_priors(priors, names, key, idx, stage="prior"):
+    """All prior draws for one trial/record, in-graph.
+
+    THE shared key-fold contract of every prior-driven subsystem: the
+    draw for slot ``s`` of ``names`` comes from
+    ``fold_in(stage_key(key, stage), s)`` — so adding or removing one
+    prior never perturbs another's stream, and two subsystems sampling
+    the same priors off different stages (the study engine's ``"prior"``,
+    the dataset factory's ``"dataset"``) draw independent streams from
+    the same per-trial key.
+
+    Args:
+        priors: ``{name: Prior}``.
+        names: slot order (canonical knob order — callers MUST pass a
+            stable ordering, never raw dict order).
+        key: the trial/record key (already derived from
+            (seed, global index) by the caller).
+        idx: traced global trial/record index (Grid priors read it).
+        stage: RNG stage from :data:`psrsigsim_tpu.utils.rng.STAGES`.
+
+    Returns ``{name: float32 scalar}`` for every name in ``names``.
+    """
+    from ..utils.rng import stage_key
+
+    pk = stage_key(key, stage)
+    return {name: priors[name].sample(jax.random.fold_in(pk, slot), idx)
+            for slot, name in enumerate(names)}
 
 
 def parse_prior(spec):

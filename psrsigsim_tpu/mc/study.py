@@ -57,7 +57,7 @@ from ..scenarios.registry import (apply_additive_effects,
                                   stack_from_knobs)
 from ..simulate.pipeline import _chan_chi2, _dispersion_delays
 from ..utils.rng import stage_key
-from .priors import Prior, parse_prior
+from .priors import Prior, parse_prior, sample_priors
 
 try:  # jax >= 0.6 stable API, else the experimental home
     shard_map = jax.shard_map
@@ -141,32 +141,12 @@ class StudyManifestError(RuntimeError):
 
 
 def _load_journal(path):
-    """Valid committed-chunk records keyed by start index.
+    """Valid committed-chunk records keyed by start index — the shared
+    torn-tail-truncating loader
+    (:func:`~psrsigsim_tpu.runtime.supervisor.load_chunk_journal`)."""
+    from ..runtime.supervisor import load_chunk_journal
 
-    Append-only + fsync'd per commit: a crash leaves at most one torn
-    final line, which is skipped AND truncated away (appending after a
-    newline-less fragment would weld records — the same rule the run
-    supervisor applies to its journal)."""
-    done = {}
-    valid_end = 0
-    try:
-        with open(path, "rb") as f:
-            for line in f:
-                if not line.endswith(b"\n"):
-                    break
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    break
-                valid_end += len(line)
-                if rec.get("e") == "chunk":
-                    done[int(rec["start"])] = rec
-    except FileNotFoundError:
-        return done
-    if valid_end < os.path.getsize(path):
-        with open(path, "rb+") as f:
-            f.truncate(valid_end)
-    return done
+    return load_chunk_journal(path)
 
 
 class MonteCarloStudy:
@@ -334,13 +314,10 @@ class MonteCarloStudy:
     def _sample_params(self, key, idx):
         """All prior draws for one trial: key fold is (trial key ->
         "prior" stage -> parameter slot), so adding/removing one prior
-        never perturbs another's stream."""
-        pk = stage_key(key, "prior")
-        out = {}
-        for slot, name in enumerate(self.param_names):
-            out[name] = self.priors[name].sample(
-                jax.random.fold_in(pk, slot), idx)
-        return out
+        never perturbs another's stream (the shared
+        :func:`~psrsigsim_tpu.mc.priors.sample_priors` contract)."""
+        return sample_priors(self.priors, self.param_names, key, idx,
+                             stage="prior")
 
     def _trial_block(self, key, idx, profiles, freqs, chan_ids):
         """One trial's simulated block ``(Nchan, Nsamp)`` + its delay

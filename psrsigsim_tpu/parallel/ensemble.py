@@ -328,6 +328,28 @@ class FoldEnsemble:
             lambda: jax.jit(
                 shard_map(_local_quantized_packed_be, **_packed_specs)))
 
+        if has_rfi:
+            # mask-only program for the FLOAT32 streaming path
+            # (iter_chunks(rfi_mask=True, quantized=False) — labeled
+            # float corpora need ground truth too): the mask is a pure
+            # function of (keys, params) built from uniform-threshold
+            # compares, so this separate program is bit-identical to the
+            # mask the fused quantized program emits (pinned by
+            # tests/test_scenarios.py's quantized-vs-float equality
+            # gate).  Registration is cheap — jit is lazy, nothing
+            # compiles unless the float mask path actually runs.
+            def _local_rfi_mask(*args):
+                return _rfi_masks(args)
+
+            self._run_sharded_rfi_mask = _registry.get_or_build(
+                ("ensemble_rfi_mask",) + _gkey,
+                lambda: jax.jit(shard_map(
+                    _local_rfi_mask,
+                    mesh=mesh,
+                    in_specs=_in_specs,
+                    out_specs=P(OBS_AXIS, CHAN_AXIS, None),
+                )))
+
     @staticmethod
     def _validate_per_obs(n_obs, dms, noise_norms):
         if dms is not None and np.shape(dms) != (n_obs,):
@@ -641,12 +663,17 @@ class FoldEnsemble:
         non-finite observations off this mask instead of re-scanning the
         payload on host.
 
-        ``rfi_mask`` (quantized, RFI-enabled scenario builds only):
-        append the in-graph ``(count, Nchan, nsub)`` ground-truth RFI
-        contamination mask to each yielded tuple (after the finite mask
-        when both are requested) — the labeled-dataset exit path, and
-        what the supervised exporter journals as scenario provenance.
-        ``scenario_params`` as :meth:`run`.
+        ``rfi_mask`` (RFI-enabled scenario builds only): append the
+        in-graph ``(count, Nchan, nsub)`` ground-truth RFI contamination
+        mask to each yielded tuple (after the finite mask when both are
+        requested) — the labeled-dataset exit path, and what the
+        supervised exporter journals as scenario provenance.  On the
+        quantized path the mask rides the fused packed transport; on the
+        float32 path (``quantized=False`` — labeled float corpora) each
+        chunk yields ``(block, mask)`` with the mask computed by a
+        dedicated program from the SAME keys/params — bit-identical to
+        the quantized path's mask (uniform-threshold draws; pinned by
+        tests).  ``scenario_params`` as :meth:`run`.
 
         ``fetch_ahead``: with ``fetch_ahead >= 1``, device->host transfers
         move to a dedicated fetch thread feeding a bounded queue of (at
@@ -677,8 +704,6 @@ class FoldEnsemble:
             raise ValueError("byte_order must be 'little' or 'big'")
         if finite_mask and not quantized:
             raise ValueError("finite_mask requires quantized=True")
-        if rfi_mask and not quantized:
-            raise ValueError("rfi_mask requires quantized=True")
         if rfi_mask and not self._has_rfi:
             raise ValueError(
                 "rfi_mask requires an ensemble built with an RFI "
@@ -717,9 +742,14 @@ class FoldEnsemble:
                 if rfi_mask:
                     dev = dev + (outs[-1][:count],)
             else:
-                out = self._run_sharded(
-                    *self._program_args(keys, dms_c, norms_c, scp))
+                args = self._program_args(keys, dms_c, norms_c, scp)
+                out = self._run_sharded(*args)
                 dev = out[:count]
+                if rfi_mask:
+                    # float corpora carry ground truth too: the mask
+                    # program shares the dispatched inputs and yields
+                    # (block, mask) per chunk
+                    dev = (dev, self._run_sharded_rfi_mask(*args)[:count])
             if timers is not None:
                 timers.add("dispatch", _time.perf_counter() - t0)
             return dev

@@ -32,6 +32,16 @@ point                     where it fires
                           kill/resume tests).  Config:
                           ``{"after_start": int}``; omit ``after_start`` to
                           kill after the first commit of any kind.
+``dataset.kill``          the dataset factory
+                          (:meth:`psrsigsim_tpu.datasets.DatasetFactory.
+                          run`), immediately after the journal commit of
+                          the record chunk starting at ``after_start``
+                          — SIGKILLs the corpus-writing process (the
+                          preempted-host case for the factory's
+                          kill/resume byte-identity tests,
+                          tests/dataset_runner.py).  Config:
+                          ``{"after_start": int}``; omit to kill after
+                          the first chunk commit.
 ``mc.kill``               the Monte-Carlo study engine
                           (:meth:`psrsigsim_tpu.mc.MonteCarloStudy.run`),
                           immediately after the journal commit of the
@@ -135,9 +145,9 @@ import signal
 __all__ = ["FaultPlan", "should_fire", "crash_process", "POINTS"]
 
 POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
-          "run.kill", "mc.kill", "serve.kill", "serve.reject",
-          "replica.kill", "cache.contend", "route.blackhole",
-          "replica.slow", "cache.enospc")
+          "run.kill", "mc.kill", "dataset.kill", "serve.kill",
+          "serve.reject", "replica.kill", "cache.contend",
+          "route.blackhole", "replica.slow", "cache.enospc")
 
 
 class FaultPlan:

@@ -50,7 +50,7 @@ from .faults import crash_process
 from .retry import RetryPolicy
 
 __all__ = ["RunSupervisor", "RunResult", "supervised_export",
-           "ProcessSupervisor"]
+           "ProcessSupervisor", "load_chunk_journal"]
 
 _JOURNAL_NAME = "run_journal.jsonl"
 _CURSOR_NAME = "run_cursor.json"
@@ -59,6 +59,41 @@ _CURSOR_NAME = "run_cursor.json"
 # fixed nonzero constant works; it only has to differ from the epoch
 # folds (small ints) other derivations use
 RETRY_FOLD_SALT = 0x7E7247
+
+
+def load_chunk_journal(path, event="chunk", key="start"):
+    """Valid committed-chunk records of an append-only fsync'd journal,
+    keyed by ``int(rec[key])`` for records whose ``"e"`` equals ``event``.
+
+    THE shared torn-tail rule of every chunked-run journal in this repo
+    (the export supervisor's, the Monte-Carlo study engine's, the
+    dataset factory's): a crash can leave at most one torn final line,
+    which is skipped AND truncated away — appending a later run's
+    records after a newline-less fragment would weld two records into
+    one permanently unparseable line, silently discarding every later
+    commit on the NEXT resume.  Truncating costs at most one chunk's
+    recompute.
+    """
+    done = {}
+    valid_end = 0
+    try:
+        with open(path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break  # torn mid-write: unsafe to append after
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                valid_end += len(line)
+                if rec.get("e") == event:
+                    done[int(rec[key])] = rec
+    except FileNotFoundError:
+        return done
+    if valid_end < os.path.getsize(path):
+        with open(path, "rb+") as f:
+            f.truncate(valid_end)
+    return done
 
 
 class RunResult:

@@ -110,6 +110,7 @@ class StageTimers:
         self._calls = {k: 0 for k in self._stages}
         self._hist = {k: [0] * LATENCY_NBINS for k in self._stages}
         self._bytes_fetched = 0
+        self._stage_bytes = {}  # stage -> payload bytes reported to it
         self._depths = {}  # queue name -> [sum, samples, max]
         self._counters = {}  # name -> int (program builds, cache events...)
         self._gauges = {}  # name -> last-set value (degraded flags, levels)
@@ -118,10 +119,15 @@ class StageTimers:
         """Accumulate ``seconds`` of busy time against ``stage`` (one of
         :data:`STAGES` or a declared extra stage; an undeclared name is
         registered on first use so a shared timer object never throws
-        from a reporting thread); ``nbytes`` counts device->host payload
-        bytes (fetch stage only, by convention).  Each call also lands
-        one sample in the stage's bounded latency histogram, from which
-        :meth:`snapshot` reports p50/p95/p99."""
+        from a reporting thread); ``nbytes`` counts the stage's payload
+        bytes — device->host transfers for ``fetch``, committed record
+        bytes for the dataset factory's ``write``, ... — accumulated
+        per stage (``<stage>_bytes`` in snapshots; the legacy
+        ``bytes_fetched`` total keeps summing every report, which
+        matches its historical value because only ``fetch`` reported
+        bytes before per-stage accounting existed).  Each call also
+        lands one sample in the stage's bounded latency histogram, from
+        which :meth:`snapshot` reports p50/p95/p99."""
         with self._lock:
             if stage not in self._seconds:
                 self._stages = self._stages + (stage,)
@@ -131,7 +137,11 @@ class StageTimers:
             self._seconds[stage] += float(seconds)
             self._calls[stage] += 1
             self._hist[stage][latency_bin_index(seconds)] += 1
-            self._bytes_fetched += int(nbytes)
+            if nbytes:
+                self._stage_bytes[stage] = (
+                    self._stage_bytes.get(stage, 0) + int(nbytes))
+                if stage == "fetch":
+                    self._bytes_fetched += int(nbytes)
 
     def histogram(self, stage):
         """A copy of one stage's latency-histogram counts (len
@@ -203,6 +213,8 @@ class StageTimers:
                         out[f"{k}_{tag}_s"] = round(
                             _hist_percentile(self._hist[k], q), 6)
             out["bytes_fetched"] = self._bytes_fetched
+            for name, n in sorted(self._stage_bytes.items()):
+                out[f"{name}_bytes"] = n
             out["wall_s"] = round(time.perf_counter() - self._t0, 6)
             for name, n in sorted(self._counters.items()):
                 out[f"{name}_count"] = n

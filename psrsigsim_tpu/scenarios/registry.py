@@ -33,7 +33,9 @@ __all__ = ["EffectParam", "Effect", "EFFECTS", "EFFECT_ORDER",
            "SP_MODE_KNOBS", "ScenarioStack", "parse_stack", "stack_label",
            "scenario_knobs", "stack_from_knobs", "param_dict",
            "default_params", "apply_pulse_effects",
-           "apply_additive_effects", "rfi_truth_mask"]
+           "apply_additive_effects", "rfi_truth_mask",
+           "apply_pulse_effects_search", "apply_additive_effects_search",
+           "energy_truth"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -365,6 +367,94 @@ def apply_additive_effects(key, block, stack, params, *, nsub, nph,
     lvl = levels * jnp.asarray(noise_level, jnp.float32)
     return (block.reshape(-1, nsub, nph)
             + lvl[:, :, None]).reshape(-1, nsub * nph)
+
+
+def _subint_of_sample(nsub, nph, nsamp):
+    """Per-sample subintegration id for a SEARCH stream: pulse ``s``
+    occupies samples ``[s*nph, (s+1)*nph)``; a ragged tail (``nsamp`` not
+    an exact pulse multiple) clamps into the last pulse so every sample
+    belongs to exactly one effect cell."""
+    import jax.numpy as jnp
+
+    return jnp.minimum(jnp.arange(nsamp, dtype=jnp.int32) // nph,
+                       nsub - 1)
+
+
+def apply_pulse_effects_search(key, block, stack, params, *, nsub, nph,
+                               nsamp, freqs, fcent_mhz, period_s,
+                               f_lo_mhz):
+    """SEARCH-mode twin of :func:`apply_pulse_effects`: multiplicative
+    effects on the synthesized single-pulse stream ``(Nchan, nsamp)``
+    (BEFORE nulling and radiometer noise).  One pulse plays the role a
+    subintegration plays in fold mode — the scintillation time cell is
+    the pulse period, and a per-pulse energy multiplies that pulse's
+    ``nph`` samples — so the SAME ops, stage keys, and parameters apply;
+    only the (subint -> sample) expansion is new.  The draws are keyed
+    identically to the fold hooks, which is what lets a label consumer
+    (:func:`rfi_truth_mask`, :func:`energy_truth`) recompute the truth
+    from the record key alone."""
+    from ..ops.scenario import pulse_energies, scint_gain
+    from ..utils.rng import stage_key
+
+    p = param_dict(stack, params)
+    sub = _subint_of_sample(nsub, nph, nsamp)
+    for name, mode in stack.entries:
+        if name == "scintillation":
+            g = scint_gain(stage_key(key, "scint"), freqs, nsub,
+                           p["scint_dnu_d_mhz"], p["scint_dt_d_s"],
+                           p["scint_mod"], fcent_mhz, period_s,
+                           f_lo_mhz=f_lo_mhz)
+            block = block * g[:, sub]
+        elif name == "single_pulse":
+            sel = {"lognormal": "sp_sigma", "powerlaw": "sp_alpha",
+                   "frb": "sp_amp"}[mode]
+            e = pulse_energies(stage_key(key, "transient"), nsub, mode,
+                               p[sel])
+            block = block * e[sub][None, :]
+    return block
+
+
+def apply_additive_effects_search(key, block, stack, params, *, nsub,
+                                  nph, nsamp, chan_ids, noise_level):
+    """SEARCH-mode twin of :func:`apply_additive_effects`: RFI rides ON
+    TOP of the radiometer noise, each contaminated (channel, pulse) cell
+    lifted by its level across the pulse's samples.  The
+    :func:`rfi_truth_mask` of the same key/params IS this injection's
+    ground truth, unchanged — the mask is per (channel, pulse)."""
+    from ..ops.scenario import rfi_levels
+    from ..utils.rng import stage_key
+
+    if "rfi" not in stack.names():
+        return block
+    import jax.numpy as jnp
+
+    p = param_dict(stack, params)
+    levels, _ = rfi_levels(stage_key(key, "rfi"), chan_ids, nsub,
+                           p["rfi_imp_prob"], p["rfi_imp_snr"],
+                           p["rfi_nb_prob"], p["rfi_nb_snr"])
+    lvl = levels * jnp.asarray(noise_level, jnp.float32)
+    sub = _subint_of_sample(nsub, nph, nsamp)
+    return block + lvl[:, sub]
+
+
+def energy_truth(key, stack, params, *, nsub):
+    """The ground-truth per-pulse energy label ``(nsub,)`` float32 for
+    one observation — recomputed from the SAME key/params as the
+    injection (:func:`apply_pulse_effects` /
+    :func:`apply_pulse_effects_search` draw the identical stream), so a
+    training-record consumer gets the true per-pulse energies without
+    re-simulating.  Returns ``None`` when the stack does not include
+    single_pulse."""
+    from ..ops.scenario import pulse_energies
+    from ..utils.rng import stage_key
+
+    if stack is None or "single_pulse" not in stack.names():
+        return None
+    mode = stack.mode("single_pulse")
+    sel = {"lognormal": "sp_sigma", "powerlaw": "sp_alpha",
+           "frb": "sp_amp"}[mode]
+    p = param_dict(stack, params)
+    return pulse_energies(stage_key(key, "transient"), nsub, mode, p[sel])
 
 
 def rfi_truth_mask(key, stack, params, *, nsub, chan_ids):

@@ -516,9 +516,10 @@ class SinglePipelineConfig:
     shift_mode: str = "envelope"  # see default_shift_mode
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "scenario"))
 def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
-                    chan_ids=None, extra_delays_ms=None):
+                    chan_ids=None, extra_delays_ms=None, scenario=None,
+                    scenario_params=None):
     """One SEARCH-mode observation as one XLA program: single-pulse
     synthesis (chi2 df=1), in-graph pulse nulling, dispersion, radiometer
     noise — the reference's ``make_pulses(fold=False) -> null -> disperse ->
@@ -528,6 +529,16 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
     window is aligned to the PORTRAIT peak (static ``cfg.peak_bin``) rather
     than to the peak of the first noisy channel-0 pulse — same window in
     expectation, deterministic in-graph.
+
+    ``scenario``/``scenario_params`` (see :func:`fold_pipeline`): the
+    SEARCH-mode scenario hooks treat one PULSE as the effect time cell
+    (registry ``apply_*_search`` twins) — scintillation gains and
+    per-pulse energies multiply the synthesized stream before nulling
+    and noise, RFI adds after the radiometer term, and every draw keys
+    off this observation's key on the effect's own stage, so the
+    registry's truth labels (``rfi_truth_mask``, ``energy_truth``)
+    recompute this exact realization.  ``scenario=None`` compiles the
+    scenario-free program bit-identically to a pre-scenario build.
 
     Args/returns: as :func:`fold_pipeline`; returns ``(Nchan, nsamp)``.
     """
@@ -551,6 +562,19 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
 
     block = block * _search_chi2(kp, chan_ids, 1.0, nsamp,
                                  cfg.meta.nchan) * cfg.draw_norm
+
+    if scenario is not None and scenario:
+        # multiplicative scenario effects modulate the PULSE stream only
+        # (the fold pipeline's ordering: emission/propagation physics
+        # before nulling, radiometer untouched) — one pulse is the time
+        # cell, so sublen_s = the pulse period
+        from ..scenarios.registry import apply_pulse_effects_search
+
+        block = apply_pulse_effects_search(
+            key, block, scenario, scenario_params, nsub=cfg.nsub,
+            nph=cfg.nph, nsamp=nsamp, freqs=freqs,
+            fcent_mhz=cfg.meta.fcent_mhz, period_s=cfg.period_s,
+            f_lo_mhz=cfg.meta.fcent_mhz - cfg.meta.bw_mhz / 2)
 
     # pulse nulling (reference: pulsar.py:246-333) — static mask arithmetic,
     # no boolean indexing.  Same keys for every channel shard -> both the
@@ -585,8 +609,20 @@ def single_pipeline(key, dm, noise_norm, profiles, cfg, freqs=None,
         block = fourier_shift(block, delays_ms, dt=cfg.dt_ms)
 
     # radiometer noise, chi2 df=1 in search mode (receiver.py:160-164)
-    return block + _search_chi2(kn, chan_ids, cfg.noise_df, nsamp,
-                                cfg.meta.nchan) * noise_norm
+    block = block + _search_chi2(kn, chan_ids, cfg.noise_df, nsamp,
+                                 cfg.meta.nchan) * noise_norm
+
+    if scenario is not None and scenario:
+        # additive effects (RFI) ride ON TOP of the radiometer noise —
+        # amplitudes in units of the mean noise level (df=1 in search
+        # mode, so the level scale is noise_df * noise_norm as in fold)
+        from ..scenarios.registry import apply_additive_effects_search
+
+        block = apply_additive_effects_search(
+            key, block, scenario, scenario_params, nsub=cfg.nsub,
+            nph=cfg.nph, nsamp=nsamp, chan_ids=chan_ids,
+            noise_level=cfg.noise_df * noise_norm)
+    return block
 
 
 def build_single_config(signal, pulsar, telescope, system, Tsys=None,

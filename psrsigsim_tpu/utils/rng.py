@@ -49,6 +49,12 @@ STAGES = {
     # and single-pulse/transient energy draws.
     "rfi": 8,
     "transient": 9,
+    # dataset-factory prior draws (psrsigsim_tpu.datasets): each training
+    # record's parameter draws live on their own stage folded off the
+    # record key, so a record depends only on (seed, global record index)
+    # and a dataset with the same seed as an MC study or an ensemble
+    # export never collides with their "prior"/pipeline streams.
+    "dataset": 10,
 }
 
 

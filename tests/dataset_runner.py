@@ -1,0 +1,84 @@
+"""Subprocess driver for dataset-factory kill/resume tests.
+
+The ``dataset.kill`` fault point SIGKILLs the corpus-writing process
+right after a chunk's journal commit, so the pytest process cannot host
+the faulted run itself — this script runs as a subprocess, dies
+mid-corpus when the armed fault fires, and is launched again (same
+out_dir, no plan, possibly a DIFFERENT chunk size) to prove the
+journaled corpus resumes to byte-identical shards.
+
+Usage::
+
+    python tests/dataset_runner.py OUT_DIR [--plan PLAN_JSON]
+        [--n-records N] [--chunk-size N] [--shards N] [--seed N]
+
+``PLAN_JSON`` holds ``{"scratch_dir": ..., "spec": {...}}`` for the
+:class:`~psrsigsim_tpu.runtime.faults.FaultPlan`.  The dataset spec is
+fixed (a tiny SEARCH geometry under an RFI + single-pulse scenario with
+dm / rfi_imp_snr priors) so every invocation with the same seed writes
+identical records.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# mirror tests/conftest.py BEFORE jax initializes: unit-test platform is
+# an 8-device virtual CPU so chunk padding matches the pytest process
+os.environ["JAX_PLATFORMS"] = os.environ.get("PSS_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPEC = {
+    "nchan": 2, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "tobs_s": 0.02, "period_s": 0.005,
+    "smean_jy": 0.05, "seed": 11, "n_records": 48, "shards": 4,
+    "dm": 10.0, "scenarios": ["rfi", "single_pulse"],
+    "rfi_imp_prob": 0.5, "rfi_nb_prob": 0.5,
+    "priors": {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0},
+               "rfi_imp_snr": {"dist": "loguniform", "lo": 1.0,
+                               "hi": 50.0}},
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--plan", default=None)
+    ap.add_argument("--n-records", type=int, default=SPEC["n_records"])
+    ap.add_argument("--chunk-size", type=int, default=8)
+    ap.add_argument("--shards", type=int, default=SPEC["shards"])
+    ap.add_argument("--seed", type=int, default=SPEC["seed"])
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", False)
+
+    from psrsigsim_tpu.datasets import DatasetFactory
+    from psrsigsim_tpu.runtime import FaultPlan
+
+    plan = None
+    if args.plan:
+        with open(args.plan) as f:
+            spec = json.load(f)
+        plan = FaultPlan(spec["scratch_dir"], spec["spec"])
+
+    ds_spec = dict(SPEC, n_records=args.n_records, shards=args.shards,
+                   seed=args.seed)
+    fac = DatasetFactory(ds_spec)
+    res = fac.run(args.out_dir, chunk_size=args.chunk_size, faults=plan)
+    print(json.dumps({"fingerprint": res["fingerprint"],
+                      "commits": res["commits"],
+                      "resumed_chunks": res["resumed_chunks"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,480 @@
+"""Dataset-factory suite: spec strictness, label ground truth, corpus
+byte-determinism, shuffle determinism, kill/resume.
+
+The load-bearing invariants (ISSUE 12 acceptance):
+
+* every emitted label is pinned BIT-IDENTICAL against the in-graph
+  ground truth (the scenario registry's truth functions recomputed from
+  the record key alone);
+* corpora are byte-identical across chunk sizes {32, 128, 512}, and
+  record content is identical across shard counts {1, 4} (the label
+  analogue of the repo's chunk-invariance contracts);
+* a SIGKILL mid-corpus (``dataset.kill``) resumes to byte-identical
+  shards — even when the resume uses a DIFFERENT chunk size
+  (tests/dataset_runner.py subprocess proof);
+* within-shard shuffling is a pure function of (seed, shard, epoch),
+  pinned to golden orderings so the algorithm can never drift silently.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from psrsigsim_tpu.datasets import (DatasetFactory, DatasetManifestError,
+                                    DatasetReader, DatasetSpecError,
+                                    RecordSampler, canonicalize,
+                                    fingerprint_hash, shuffled_order)
+from psrsigsim_tpu.datasets.writer import (encode_record, parse_record,
+                                           record_stride, shard_of,
+                                           slot_of)
+from psrsigsim_tpu.mc.priors import parse_prior, sample_priors
+from psrsigsim_tpu.scenarios.registry import (energy_truth, parse_stack,
+                                              rfi_truth_mask)
+from psrsigsim_tpu.utils.rng import STAGES, stage_key
+
+RUNNER = os.path.join(os.path.dirname(__file__), "dataset_runner.py")
+
+# tiny SEARCH geometry: nph=1024 samples/period, 4 pulses, nsamp=4096
+BASE_SPEC = {
+    "nchan": 2, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "tobs_s": 0.02, "period_s": 0.005,
+    "smean_jy": 0.05, "seed": 11, "n_records": 48, "shards": 1,
+    "dm": 10.0,
+}
+
+# the labeled-corpus spec: RFI + single-pulse labels, dm/rfi_imp_snr/
+# sp_sigma varied per record (injection parameters), high fixed probs so
+# every corpus is guaranteed contaminated cells to pin
+SCN_SPEC = dict(
+    BASE_SPEC,
+    scenarios=["rfi", "single_pulse"],
+    rfi_imp_prob=0.5, rfi_nb_prob=0.5,
+    priors={"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0},
+            "rfi_imp_snr": {"dist": "loguniform", "lo": 1.0, "hi": 50.0},
+            "sp_sigma": {"dist": "uniform", "lo": 0.1, "hi": 1.0}},
+)
+
+
+def _corpus_sha(out_dir):
+    h = hashlib.sha256()
+    for p in sorted(glob.glob(os.path.join(out_dir, "shard-*.records"))):
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+@pytest.fixture(scope="module")
+def scn_corpus(tmp_path_factory):
+    """One 48-record labeled corpus (single shard) shared by the
+    read-only assertions."""
+    out = str(tmp_path_factory.mktemp("scn") / "corpus")
+    fac = DatasetFactory(SCN_SPEC)
+    res = fac.run(out, chunk_size=16)
+    return fac, out, res
+
+
+# ---------------------------------------------------------------------------
+# Spec validation
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_canonical_fingerprint_normalizes_numerics(self):
+        a = canonicalize(dict(SCN_SPEC, dm=10))
+        b = canonicalize(dict(SCN_SPEC, dm=10.0))
+        assert fingerprint_hash(a) == fingerprint_hash(b)
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(DatasetSpecError, match="unknown field"):
+            canonicalize(dict(BASE_SPEC, noise_scael=2.0))
+
+    def test_missing_required_all_named(self):
+        with pytest.raises(DatasetSpecError) as err:
+            canonicalize({"nchan": 2})
+        msg = str(err.value)
+        for f in ("fcent_mhz", "seed", "n_records", "dm"):
+            assert f in msg
+
+    def test_param_for_disabled_effect_rejected(self):
+        with pytest.raises(DatasetSpecError, match="requires effect"):
+            canonicalize(dict(BASE_SPEC, rfi_imp_snr=5.0))
+
+    def test_prior_on_disabled_knob_rejected(self):
+        with pytest.raises(DatasetSpecError, match="priors.sp_sigma"):
+            canonicalize(dict(
+                BASE_SPEC,
+                priors={"sp_sigma": {"dist": "uniform", "lo": 0.1,
+                                     "hi": 1.0}}))
+
+    def test_bad_prior_spec_rejected(self):
+        with pytest.raises(DatasetSpecError, match="priors.dm"):
+            canonicalize(dict(
+                BASE_SPEC, priors={"dm": {"dist": "nope"}}))
+
+    def test_scenario_field_changes_fingerprint_and_schema(self):
+        plain = DatasetFactory(BASE_SPEC)
+        labeled = DatasetFactory(SCN_SPEC)
+        assert plain.fingerprint != labeled.fingerprint
+        plain_fields = {n for n, _, _ in plain.sampler.field_layout()}
+        labeled_fields = {n for n, _, _ in labeled.sampler.field_layout()}
+        assert "rfi_mask" not in plain_fields
+        assert {"rfi_mask", "energies"} <= labeled_fields
+
+    def test_dataset_rng_stage_registered(self):
+        """The record sampler's prior draws live on their own stage."""
+        assert "dataset" in STAGES
+        assert len(set(STAGES.values())) == len(STAGES)
+
+
+# ---------------------------------------------------------------------------
+# Record format + shuffle
+# ---------------------------------------------------------------------------
+
+
+class TestRecordFormat:
+    LAYOUT = [("params", "<f4", (2,)), ("tile", "<f4", (3, 4))]
+
+    def test_encode_parse_roundtrip(self):
+        arrays = {"params": np.asarray([1.5, -2.0], np.float32),
+                  "tile": np.arange(12, dtype=np.float32).reshape(3, 4)}
+        buf = encode_record(7, arrays, self.LAYOUT, 1)
+        assert len(buf) == record_stride(self.LAYOUT)
+        rec = parse_record(buf, self.LAYOUT, 1)
+        assert rec["index"] == 7
+        np.testing.assert_array_equal(rec["params"], arrays["params"])
+        np.testing.assert_array_equal(rec["tile"], arrays["tile"])
+
+    def test_parse_rejects_bad_magic_and_version(self):
+        arrays = {"params": np.zeros(2, np.float32),
+                  "tile": np.zeros((3, 4), np.float32)}
+        buf = encode_record(0, arrays, self.LAYOUT, 1)
+        with pytest.raises(ValueError, match="magic"):
+            parse_record(b"XXXX" + buf[4:], self.LAYOUT, 1)
+        with pytest.raises(ValueError, match="version"):
+            parse_record(buf, self.LAYOUT, 2)
+
+    def test_shard_layout_pure_function(self):
+        # record i -> shard i % S, slot i // S: chunk/order independent
+        for i in (0, 5, 47):
+            assert shard_of(i, 4) == i % 4
+            assert slot_of(i, 4) == i // 4
+
+
+class TestShuffle:
+    def test_is_a_permutation(self):
+        o = shuffled_order(100, 3, 1, 2)
+        assert sorted(o) == list(range(100))
+
+    def test_pure_function_of_seed_shard_epoch(self):
+        assert shuffled_order(64, 5, 2, 9) == shuffled_order(64, 5, 2, 9)
+        assert shuffled_order(64, 5, 2, 9) != shuffled_order(64, 5, 2, 10)
+        assert shuffled_order(64, 5, 2, 9) != shuffled_order(64, 5, 3, 9)
+        assert shuffled_order(64, 5, 2, 9) != shuffled_order(64, 6, 2, 9)
+
+    def test_golden_orders_pinned(self):
+        """The sha256 Fisher-Yates must never drift: a corpus consumer's
+        epoch schedule is reproducible from (seed, shard, epoch) forever.
+        These orders were computed at introduction (PR 12) and are the
+        contract."""
+        assert shuffled_order(8, 1, 0, 0) == [6, 1, 5, 0, 7, 4, 3, 2]
+        assert shuffled_order(8, 1, 0, 1) == [3, 2, 0, 7, 6, 5, 1, 4]
+        assert shuffled_order(8, 1, 1, 0) == [4, 6, 7, 3, 5, 1, 2, 0]
+
+
+# ---------------------------------------------------------------------------
+# Label ground truth
+# ---------------------------------------------------------------------------
+
+
+def _ground_truth(canonical, index):
+    """Recompute one record's labels from (seed, index) alone, through
+    the registry truth functions — the independent in-graph oracle the
+    written corpus must match bit for bit.
+
+    The oracle runs under ``jax.jit`` (single record, no vmap, no
+    shard_map — a genuinely different program shape than the sampler's
+    chunk program): compiled-to-compiled the labels are bit-identical;
+    only EAGER evaluation of the transcendental energy draws rounds one
+    ulp differently on CPU, the same compiled-vs-eager caveat the rest
+    of the repo documents."""
+    stack = parse_stack(canonical["scenarios"])
+    priors = {k: parse_prior(s) for k, s in canonical["priors"].items()}
+    knobs = ("dm", "noise_scale") + tuple(stack.param_names())
+    names = tuple(k for k in knobs if k in priors)
+    nsub = int(round(canonical["tobs_s"] / canonical["period_s"]))
+
+    @jax.jit
+    def oracle(key, idx):
+        p = sample_priors(priors, names, key, idx, stage="dataset")
+        sc = {n: p.get(n, jnp.float32(canonical[n]))
+              for n in stack.param_names()}
+        mask = rfi_truth_mask(key, stack, sc, nsub=nsub,
+                              chan_ids=jnp.arange(canonical["nchan"]))
+        en = energy_truth(key, stack, sc, nsub=nsub)
+        params = jnp.stack([p[n] for n in names]) if names \
+            else jnp.zeros((0,), jnp.float32)
+        scn = jnp.stack([sc[n] for n in stack.param_names()])
+        return mask.astype(jnp.uint8), en, params, scn
+
+    key = stage_key(jax.random.key(canonical["seed"]), "user", index)
+    mask, en, params, scn = jax.device_get(oracle(key, jnp.int32(index)))
+    return {"rfi_mask": mask, "energies": en, "params": params,
+            "scenario_params": scn}
+
+
+class TestLabelIntegrity:
+    def test_every_label_pinned_against_ground_truth(self, scn_corpus):
+        """Every record of the corpus: RFI mask, per-pulse energies, and
+        injection parameters all equal the in-graph ground truth
+        recomputed from (seed, global index) — bit-identical."""
+        fac, out, _ = scn_corpus
+        reader = DatasetReader(out)
+        assert reader.n_records == SCN_SPEC["n_records"]
+        some_mask = False
+        for i in range(reader.n_records):
+            rec = reader.read_index(i)
+            truth = _ground_truth(fac.canonical, i)
+            for name in ("rfi_mask", "energies", "params",
+                         "scenario_params"):
+                np.testing.assert_array_equal(
+                    rec[name], truth[name],
+                    err_msg=f"record {i} label {name}")
+            some_mask = some_mask or rec["rfi_mask"].any()
+        assert some_mask  # prob 0.5 over 48 records: astronomically sure
+
+    def test_mask_marks_the_contaminated_tile_cells(self, tmp_path):
+        """The mask is REAL ground truth for the tile bytes: the same
+        corpus with injection amplitudes zeroed differs exactly on the
+        masked (channel, pulse) windows."""
+        spec_on = dict(SCN_SPEC, n_records=8, shards=1,
+                       rfi_nb_snr=50.0,
+                       priors={"rfi_imp_snr": {"dist": "fixed",
+                                               "value": 50.0}})
+        spec_off = dict(spec_on, rfi_nb_prob=0.0, rfi_imp_prob=0.0)
+        out_on = str(tmp_path / "on")
+        out_off = str(tmp_path / "off")
+        DatasetFactory(spec_on).run(out_on, chunk_size=8)
+        DatasetFactory(spec_off).run(out_off, chunk_size=8)
+        r_on, r_off = DatasetReader(out_on), DatasetReader(out_off)
+        nsub = int(round(SCN_SPEC["tobs_s"] / SCN_SPEC["period_s"]))
+        nph = r_on.layout[-1][2][1] // nsub  # nsamp / nsub
+        hit = False
+        for i in range(8):
+            a, b = r_on.read_index(i), r_off.read_index(i)
+            diff = (a["tile"] != b["tile"]).reshape(
+                a["tile"].shape[0], nsub, nph).any(axis=-1)
+            np.testing.assert_array_equal(
+                diff, a["rfi_mask"].astype(bool),
+                err_msg=f"record {i}: tile diff != mask")
+            assert not b["rfi_mask"].any()
+            hit = hit or diff.any()
+        assert hit
+
+    def test_energies_modulate_the_pulse_windows(self, tmp_path):
+        """FRB mode: exactly one pulse window carries the burst and the
+        energies label names it."""
+        spec = dict(BASE_SPEC, n_records=4,
+                    scenarios=["single_pulse:frb"], sp_amp=100.0)
+        out = str(tmp_path / "frb")
+        DatasetFactory(spec).run(out, chunk_size=4)
+        reader = DatasetReader(out)
+        for i in range(4):
+            rec = reader.read_index(i)
+            e = rec["energies"]
+            assert (e > 0).sum() == 1  # one-off burst
+            assert e.max() == np.float32(100.0)
+
+
+# ---------------------------------------------------------------------------
+# Determinism: chunk sizes, shard counts, resume
+# ---------------------------------------------------------------------------
+
+
+class TestCorpusDeterminism:
+    @pytest.mark.slow
+    def test_chunk_size_invariance_512(self, tmp_path):
+        """The acceptance matrix at full size: byte-identical shards at
+        chunk sizes {32, 128, 512} over a 512-record corpus (records —
+        labels included — are pure functions of (seed, index))."""
+        spec = dict(SCN_SPEC, n_records=512, shards=4)
+        shas = []
+        for cs in (32, 128, 512):
+            out = str(tmp_path / f"c{cs}")
+            DatasetFactory(spec).run(out, chunk_size=cs)
+            shas.append(_corpus_sha(out))
+        assert shas[0] == shas[1] == shas[2]
+
+    def test_chunk_size_invariance_small(self, tmp_path):
+        """Tier-1-fast twin of the 512-record matrix (the same program
+        widths {32, 128, 512} — the large sizes clamp to n_records)."""
+        spec = dict(SCN_SPEC, n_records=48, shards=4)
+        shas = []
+        for cs in (32, 128, 512):
+            out = str(tmp_path / f"c{cs}")
+            DatasetFactory(spec).run(out, chunk_size=cs)
+            shas.append(_corpus_sha(out))
+        assert shas[0] == shas[1] == shas[2]
+
+    def test_shard_count_invariance(self, scn_corpus, tmp_path):
+        """Record CONTENT is shard-count independent: the same records
+        land in different files for shards {1, 4}, byte-equal record by
+        record."""
+        fac, out1, _ = scn_corpus
+        out4 = str(tmp_path / "s4")
+        DatasetFactory(dict(SCN_SPEC, shards=4)).run(out4, chunk_size=16)
+        r1, r4 = DatasetReader(out1), DatasetReader(out4)
+        assert (r1.n_shards, r4.n_shards) == (1, 4)
+        for i in range(r1.n_records):
+            a, b = r1.read_index(i), r4.read_index(i)
+            for name in ("params", "scenario_params", "energies",
+                         "rfi_mask", "tile"):
+                np.testing.assert_array_equal(a[name], b[name],
+                                              err_msg=f"record {i} {name}")
+
+    def test_stop_and_resume_changed_chunk_size(self, tmp_path):
+        """An interrupted run resumed with a DIFFERENT chunk size still
+        lands byte-identical shards (positional slots + pure-function
+        records: recomputed chunks overwrite with identical bytes)."""
+        ref = str(tmp_path / "ref")
+        fac = DatasetFactory(SCN_SPEC)
+        fac.run(ref, chunk_size=16)
+        ref_sha = _corpus_sha(ref)
+
+        out = str(tmp_path / "resume")
+        stopped = DatasetFactory(SCN_SPEC).run(out, chunk_size=8,
+                                               _stop_after_chunks=2)
+        assert stopped is None
+        res = DatasetFactory(SCN_SPEC).run(out, chunk_size=12)
+        assert res["commits"] > 0
+        assert _corpus_sha(out) == ref_sha
+
+    def test_resume_same_chunk_size_skips_committed(self, tmp_path):
+        out = str(tmp_path / "skip")
+        DatasetFactory(SCN_SPEC).run(out, chunk_size=8,
+                                     _stop_after_chunks=2)
+        res = DatasetFactory(SCN_SPEC).run(out, chunk_size=8)
+        assert res["resumed_chunks"] == 2
+        assert res["commits"] == 48 // 8 - 2
+
+    def test_overwrite_removes_every_stale_corpus_byte(self, tmp_path):
+        """resume=False (the documented overwrite path) over a LARGER
+        previous corpus: stale shard tail bytes and stale shard/index
+        files must not survive — the directory must end up byte-identical
+        to a fresh-directory run of the new spec."""
+        out = str(tmp_path / "reuse")
+        big = dict(SCN_SPEC, n_records=96, shards=4)
+        DatasetFactory(big).run(out, chunk_size=16)
+        small = dict(SCN_SPEC, n_records=24, shards=2)
+        DatasetFactory(small).run(out, chunk_size=8, resume=False)
+        fresh = str(tmp_path / "fresh")
+        DatasetFactory(small).run(fresh, chunk_size=8)
+        assert _corpus_sha(out) == _corpus_sha(fresh)
+        assert sorted(os.path.basename(p) for p in glob.glob(
+            os.path.join(out, "shard-*"))) \
+            == sorted(os.path.basename(p) for p in glob.glob(
+                os.path.join(fresh, "shard-*")))
+
+    def test_manifest_guards_different_spec(self, scn_corpus):
+        _, out, _ = scn_corpus
+        other = DatasetFactory(dict(SCN_SPEC, dm=11.0))
+        with pytest.raises(DatasetManifestError, match="fingerprint"):
+            other.run(out, chunk_size=16)
+
+    def test_shared_registry_one_program_per_width(self):
+        """Two factories over the same physics share ONE compiled record
+        program (the shared-registry contract)."""
+        a = RecordSampler(canonicalize(SCN_SPEC))
+        b = RecordSampler(canonicalize(dict(SCN_SPEC, seed=99,
+                                            n_records=16)))
+        assert a._program_digest == b._program_digest
+        assert a.program(16) is b.program(16)
+
+
+# ---------------------------------------------------------------------------
+# Reader + epochs
+# ---------------------------------------------------------------------------
+
+
+class TestReader:
+    def test_epoch_covers_every_record_once(self, scn_corpus):
+        _, out, _ = scn_corpus
+        reader = DatasetReader(out)
+        seen = [rec["index"] for rec in reader.iter_epoch(0)]
+        assert sorted(seen) == list(range(reader.n_records))
+        seen1 = [rec["index"] for rec in reader.iter_epoch(1)]
+        assert sorted(seen1) == sorted(seen)
+        assert seen1 != seen  # different epoch, different order
+
+    def test_reader_is_self_describing(self, scn_corpus):
+        fac, out, _ = scn_corpus
+        reader = DatasetReader(out)
+        assert reader.fingerprint == fac.fingerprint
+        assert [n for n, _, _ in reader.layout] \
+            == [n for n, _, _ in fac.sampler.field_layout()]
+
+    def test_telemetry_reports_stages_and_bytes(self, scn_corpus):
+        _, _, res = scn_corpus
+        snap = res["telemetry"]
+        for stage in ("dispatch", "fetch", "encode", "write"):
+            assert snap[f"{stage}_calls"] > 0, stage
+        assert snap["records_count"] == SCN_SPEC["n_records"]
+        assert snap["write_bytes"] == res["stride"] * SCN_SPEC["n_records"]
+        assert snap["fetch_bytes"] == snap["bytes_fetched"] > 0
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-corpus (subprocess proof)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+class TestKillResume:
+    def test_sigkill_mid_corpus_resumes_byte_identical(self, tmp_path):
+        """dataset.kill fires right after the 3rd chunk's journal
+        commit: the factory dies with SIGKILL; the resume run — with a
+        DIFFERENT chunk size — completes the corpus byte-identical to an
+        uninterrupted run."""
+        import dataset_runner
+
+        # the clean reference runs in-process over the runner's OWN spec
+        # (asserted identical so the two can never drift)
+        clean = str(tmp_path / "clean")
+        fac = DatasetFactory(dataset_runner.SPEC)
+        fac.run(clean, chunk_size=8)
+        clean_sha = _corpus_sha(clean)
+
+        plan_file = str(tmp_path / "plan.json")
+        with open(plan_file, "w") as f:
+            json.dump({"scratch_dir": str(tmp_path / "scratch"),
+                       "spec": {"dataset.kill": {"after_start": 16}}}, f)
+        killed = str(tmp_path / "killed")
+        proc = subprocess.run(
+            [sys.executable, RUNNER, killed, "--plan", plan_file,
+             "--chunk-size", "8"],
+            capture_output=True, text=True, timeout=540)
+        assert proc.returncode in (-9, 137), (
+            f"expected SIGKILL, got rc={proc.returncode}\n{proc.stderr}")
+        # the journal committed chunks up to the kill point
+        journal = os.path.join(killed, "dataset_journal.jsonl")
+        assert os.path.exists(journal)
+        committed = [json.loads(l) for l in open(journal)]
+        assert {r["start"] for r in committed} == {0, 8, 16}
+
+        proc = subprocess.run(
+            [sys.executable, RUNNER, killed, "--plan", plan_file,
+             "--chunk-size", "12"],
+            capture_output=True, text=True, timeout=540)
+        assert proc.returncode == 0, proc.stderr
+        resumed = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert resumed["fingerprint"] == fac.fingerprint
+        assert _corpus_sha(killed) == clean_sha, (
+            "shards differ after SIGKILL + changed-chunk-size resume")

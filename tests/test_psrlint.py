@@ -465,3 +465,15 @@ class TestTraceProbe:
 
         results = run_serve_trace_check(widths=(1, 8))
         assert [r.status for r in results] == ["ok", "ok"]
+
+    def test_dataset_record_program_traces_clean(self):
+        """The dataset factory's labeled-record body (prior draws on the
+        "dataset" stage + SEARCH scenario hooks + registry truth labels)
+        traces, abstract-evals, and holds a stable jit cache — a
+        trace-unsafe edit anywhere in that composition fails here before
+        it reaches a corpus run."""
+        from psrsigsim_tpu.analysis.trace_check import (
+            run_dataset_trace_check)
+
+        results = run_dataset_trace_check()
+        assert [r.status for r in results] == ["ok"]

@@ -496,6 +496,34 @@ class TestRfiMaskFlow:
         np.testing.assert_array_equal(np.concatenate(parts),
                                       np.asarray(ref))
 
+    def test_float_path_mask_equals_quantized_mask(self, rfi_ens):
+        """float32 corpora get ground truth too: iter_chunks(rfi_mask=
+        True) without quantized=True yields (block, mask) chunks whose
+        mask is BIT-identical to the fused quantized transport's (the
+        mask is uniform-threshold draws — exact under any program
+        shape), and the float blocks themselves are untouched by asking
+        for it."""
+        sp = _params_for(["rfi"])
+        _, _, _, ref = rfi_ens.run_quantized(8, seed=0, return_rfi=True,
+                                             scenario_params=sp)
+        blocks, masks = [], []
+        for _, (blk, mask) in rfi_ens.iter_chunks(
+                8, chunk_size=4, seed=0, rfi_mask=True,
+                scenario_params=sp):
+            blocks.append(np.asarray(blk))
+            masks.append(np.asarray(mask))
+        np.testing.assert_array_equal(np.concatenate(masks),
+                                      np.asarray(ref))
+        plain = [np.asarray(b) for _, b in rfi_ens.iter_chunks(
+            8, chunk_size=4, seed=0, scenario_params=sp)]
+        np.testing.assert_array_equal(np.concatenate(blocks),
+                                      np.concatenate(plain))
+
+    def test_float_mask_without_rfi_scenario_rejected(self):
+        ens = _ensemble()
+        with pytest.raises(ValueError, match="rfi_mask requires"):
+            list(ens.iter_chunks(4, chunk_size=4, rfi_mask=True))
+
     def test_supervised_export_journals_provenance(self, tmp_path):
         """The labeled-dataset exit: a supervised RFI export lands the
         contamination record in the manifest and the fsync'd journal."""
