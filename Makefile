@@ -30,6 +30,14 @@
 # replica with half-open recovery, ENOSPC pass-through degradation,
 # and saturation 429/Retry-After + admission shedding
 # (bench.py elastic_smoke).
+# `make bench-c10k` is the C10k front-end gate: >= 10k concurrent
+# keep-alive connections through the aio event loop byte-identical to
+# a solo threaded baseline (mid-storm replica kill survived), hot-tier
+# hits with ZERO disk reads and ZERO device calls (counter-gated),
+# pooled keep-alive routing with breaker-aware socket eviction, fd
+# hygiene, and the threaded-vs-aio level bench (aio >= threaded req/s
+# at every shared level, p99 strictly better at threaded's max)
+# (bench.py c10k_smoke; PSS_BENCH_C10K_CONNS sizes the storm).
 # `make bench-dataset` is the dataset-factory gate: byte-identical
 # labeled corpora across chunk sizes {32,128,512}, SIGKILL-style
 # interruption resumed (with a changed chunk size) to byte-identical
@@ -38,7 +46,7 @@
 # naming the bottleneck (bench.py dataset_smoke).
 
 .PHONY: lint test test-faults bench-export bench-mc serve-smoke \
-	bench-scenarios fleet-smoke elastic-smoke bench-dataset
+	bench-scenarios fleet-smoke elastic-smoke bench-c10k bench-dataset
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -66,6 +74,9 @@ fleet-smoke:
 
 elastic-smoke:
 	JAX_PLATFORMS=cpu python bench.py --elastic-smoke
+
+bench-c10k:
+	JAX_PLATFORMS=cpu python bench.py --c10k-smoke
 
 bench-dataset:
 	JAX_PLATFORMS=cpu python bench.py --dataset-smoke

@@ -1938,6 +1938,122 @@ def elastic_smoke():
             "saturation": v["saturation"], "ok": True}
 
 
+# ---------------------------------------------------------------------------
+# Config 13: C10k front end (event loop + hot tier + pooled routing, PR 13)
+# ---------------------------------------------------------------------------
+
+
+def time_c10k(conns=None):
+    """Config 13: req/s and client-side p99 at 100/1k/10k concurrent
+    keep-alive connections, threaded vs aio front end over one
+    pre-committed hot spec set — the connection-layer headroom the
+    event loop adds, measured.  The device is idle BY DESIGN (every
+    response is a cache-tier hit), so this isolates exactly the layer
+    PR 13 replaced; the threaded server is only driven up to the
+    concurrency it survives (``threaded_max``)."""
+    import shutil
+    import tempfile
+
+    if conns is None:
+        conns = int(os.environ.get("PSS_BENCH_C10K_CONNS", "10000"))
+    out = tempfile.mkdtemp(prefix="pss_c10k_bench_")
+    try:
+        v = _run_fleet_runner(
+            ["--mode", "c10k-bench", "--out", out, "--conns", str(conns)],
+            timeout=1200)
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    if not v["ok"]:
+        raise RuntimeError(f"c10k bench verdict not ok: {v}")
+    d = {"levels": v["levels"], "threaded_max": v["threaded_max"],
+         "hot_hit_rate": v["hot_hit_rate"]}
+    for fe in ("threaded", "aio"):
+        for lv, s in v[fe].items():
+            d[f"{fe}_req_per_sec_{lv}"] = s["req_per_sec"]
+            d[f"{fe}_p99_s_{lv}"] = s["p99_s"]
+    top = str(max(v["levels"]))
+    thr_top = str(max(int(k) for k in v["threaded"]))
+    d["aio_conns_top"] = int(top)
+    d["aio_req_per_sec_top"] = v["aio"][top]["req_per_sec"]
+    d["aio_p99_s_top"] = v["aio"][top]["p99_s"]
+    # headline ratio at the highest level BOTH front ends ran
+    d["aio_over_threaded"] = round(
+        v["aio"][thr_top]["req_per_sec"]
+        / max(v["threaded"][thr_top]["req_per_sec"], 1e-9), 2)
+    d["threaded_p99_s_at_max"] = v["threaded"][thr_top]["p99_s"]
+    d["aio_p99_s_at_threaded_max"] = v["aio"][thr_top]["p99_s"]
+    return d
+
+
+def c10k_smoke():
+    """Quick C10k gate (``make bench-c10k``): (a) thousands of
+    concurrent keep-alive connections (default 10000, rlimit-clamped;
+    ``PSS_BENCH_C10K_CONNS``) through the aio front end with every
+    response BYTE-identical to a solo threaded baseline, surviving a
+    mid-storm replica SIGKILL (clients reconnect to survivors, the
+    supervisor restarts the corpse, zero lost commits); (b) the
+    steady-state round's repeated-hash hits perform ZERO disk reads
+    and ZERO device calls — counter-gated: the in-memory hot tier and
+    the zero-copy rendered-body memo carry the whole round; (c) pooled
+    keep-alive routing reuses upstream sockets (pool hits > 0) and a
+    breaker-opened replica's pooled sockets are closed within the
+    breaker window; (d) fd hygiene — the harness's fd census returns
+    to baseline after drain; (e) the level bench: aio req/s >= threaded
+    req/s at every shared level and p99 strictly better at the highest
+    concurrency the threaded server was driven at."""
+    import shutil
+    import tempfile
+
+    conns = int(os.environ.get("PSS_BENCH_C10K_CONNS", "10000"))
+    out = tempfile.mkdtemp(prefix="pss_c10k_smoke_")
+    try:
+        v = _run_fleet_runner(
+            ["--mode", "c10k", "--out", os.path.join(out, "c"),
+             "--conns", str(conns)], timeout=1200)
+        bench = _run_fleet_runner(
+            ["--mode", "c10k-bench", "--out", os.path.join(out, "b"),
+             "--conns", str(conns)], timeout=1200)
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    storm = v["storm"]
+    assert v["byte_identical"] and not storm["n_errors"], (
+        "aio storm responses NOT byte-identical to the solo threaded "
+        f"baseline: {storm.get('errors')}")                        # (a)
+    assert storm["established"] >= v["conns"], storm
+    assert storm["reconnects"] >= 1 and storm["restarts"] >= 1, storm
+    assert storm["recovered"] and storm["server_conns_drained"], storm
+    assert v["storm_audit"]["lost_commits"] == 0, v["storm_audit"]
+    assert storm["disk_hits_delta_steady"] == 0, (
+        f"steady-state hits read disk: {storm}")                   # (b)
+    assert storm["device_calls"] == 0, storm
+    assert storm["hot_hits_delta_steady"] >= v["conns"], storm
+    pool = v["pool"]
+    assert pool["pool_hits"] > 0, pool                             # (c)
+    assert pool["breaker_opened"] and pool["victim_pooled_after"] == 0, \
+        pool
+    assert v["fd_leak"] <= 16, (
+        f"fd census leaked {v['fd_leak']} past baseline")          # (d)
+    assert v["ok"], v
+    assert bench["ok"], bench                                      # (e)
+    shared = [lv for lv in bench["threaded"] if lv in bench["aio"]]
+    for lv in shared:
+        assert (bench["aio"][lv]["req_per_sec"]
+                >= bench["threaded"][lv]["req_per_sec"]), (
+            f"aio slower than threaded at {lv} conns: {bench}")
+    thr_top = str(max(int(k) for k in bench["threaded"]))
+    assert (bench["aio"][thr_top]["p99_s"]
+            < bench["threaded"][thr_top]["p99_s"]), (
+        f"aio p99 not better at {thr_top} conns: {bench}")
+    return {"metric": "c10k_smoke", "conns": v["conns"],
+            "storm": storm, "pool": {
+                "pool_hits": pool["pool_hits"],
+                "pool_misses": pool["pool_misses"],
+                "breaker_opened": pool["breaker_opened"],
+                "victim_pooled_before": pool["victim_pooled_before"],
+                "victim_pooled_after": pool["victim_pooled_after"]},
+            "fd_leak": v["fd_leak"], "bench": bench, "ok": True}
+
+
 _SCENARIO_STACKS = ("scintillation", "rfi", "single_pulse",
                     "scintillation+rfi+single_pulse:powerlaw")
 
@@ -2526,6 +2642,10 @@ _COMPACT_FIELDS = (
     ("elastic_req_per_sec_4x_over_fixed", "espd", 2),
     ("elastic_req_per_sec_4x", "ereq4", 1),
     ("elastic_p99_s_4x", "ep99", 3),
+    ("aio_req_per_sec_top", "aioreq", 0),
+    ("aio_p99_s_top", "aiop99", 3),
+    ("aio_conns_top", "aioconn", None),
+    ("aio_over_threaded", "aiospd", 1),
     ("max_active", "mact", None),
     ("request_p99_s", "p99_s", 4),
     ("cache_hit_req_per_sec", "hit_s", 1),
@@ -2651,6 +2771,14 @@ def main():
         # saturation 429/Retry-After gates
         with contextlib.redirect_stdout(sys.stderr):
             result = elastic_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
+    if "--c10k-smoke" in sys.argv[1:]:
+        # `make bench-c10k`: 10k-connection aio storm byte identity +
+        # hot-tier zero-disk-read + pooled-routing eviction + fd
+        # hygiene + threaded-vs-aio level gates
+        with contextlib.redirect_stdout(sys.stderr):
+            result = c10k_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
     if "--dataset-smoke" in sys.argv[1:]:
@@ -2856,6 +2984,18 @@ def _main():
         f"req/s (p99 {ela['elastic_p99_s_4x']:.2f}s) -> "
         f"{ela['elastic_over_fixed']:.2f}x; scale_events "
         f"{ela['scale_events']}, max_active {ela['max_active']}")
+    _checkpoint(detail)
+
+    # --- config 13: C10k front end (threaded vs aio levels) -------------
+    c10 = time_c10k()
+    detail["config13_c10k"] = c10
+    log(f"config13_c10k: aio {c10['aio_req_per_sec_top']:.0f} req/s "
+        f"(p99 {c10['aio_p99_s_top']:.3f}s) at {c10['aio_conns_top']} "
+        f"conns; at {c10['threaded_max']} conns aio/threaded "
+        f"{c10['aio_over_threaded']:.1f}x (p99 "
+        f"{c10['aio_p99_s_at_threaded_max']:.3f}s vs "
+        f"{c10['threaded_p99_s_at_max']:.3f}s); hot hit rate "
+        f"{c10['hot_hit_rate']}")
     _checkpoint(detail)
 
     # --- config 12: SEARCH-mode dataset factory -------------------------

@@ -179,6 +179,15 @@ class StageTimers:
         with self._lock:
             self._gauges[name] = value
 
+    def set_gauges(self, values):
+        """Set several gauges under ONE lock acquisition — the serving
+        front end's periodic tick (open connections, event-loop lag,
+        pending write bytes) exports its gauges in a batch so a
+        hot event loop pays one lock round-trip per tick, not one per
+        gauge."""
+        with self._lock:
+            self._gauges.update(values)
+
     def gauge_value(self, name, default=None):
         with self._lock:
             return self._gauges.get(name, default)

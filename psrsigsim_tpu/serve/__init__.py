@@ -23,7 +23,16 @@ The subsystem that turns the batch pipelines into a service
 - :mod:`~psrsigsim_tpu.serve.http` / ``python -m psrsigsim_tpu.serve``
   — the stdlib ThreadingHTTPServer JSON API (``/simulate``,
   ``/status/<id>``, ``/result/<id>``, ``/healthz``, ``/metrics``) with
-  graceful drain on SIGTERM.
+  graceful drain on SIGTERM; the endpoint SEMANTICS are module-level
+  functions shared with the aio front end, so responses are
+  byte-identical across front ends.
+- :mod:`~psrsigsim_tpu.serve.aio` — :class:`AioHTTPServer`: the C10k
+  front end — a dependency-free ``selectors`` event loop multiplexing
+  thousands of keep-alive connections (pipelined-safe incremental
+  parsing, bounded buffers, idle reaping), waited requests resolved by
+  completion callbacks instead of blocked threads, and hot ``/result``
+  bodies streamed as zero-copy ``memoryview`` slices of a
+  once-rendered byte-bounded memo.  ``--frontend aio`` selects it.
 - :mod:`~psrsigsim_tpu.serve.fleet` — :class:`ReplicaFleet`: N
   supervised server subprocesses over ONE shared cache dir,
   health-checked via ``/healthz``, restarted with jittered backoff,
@@ -44,10 +53,12 @@ The subsystem that turns the batch pipelines into a service
   polling cannot see.
 """
 
-from .cache import ResultCache
+from .aio import AioHTTPServer, make_aio_server
+from .cache import ByteLRU, ResultCache
 from .fleet import ReplicaFleet
 from .programs import DEFAULT_WIDTHS, ProgramRegistry, enable_compilation_cache
-from .router import FleetRouter, RouteFailed, make_router_server
+from .router import (FleetRouter, PooledTransport, RouteFailed,
+                     make_router_server)
 from .service import (RequestFailed, RequestRejected, SERVE_STAGES,
                       SimulationService)
 from .spec import (SpecError, build_geometry, canonicalize, geometry_hash,
@@ -58,10 +69,14 @@ __all__ = [
     "RequestRejected",
     "RequestFailed",
     "ResultCache",
+    "ByteLRU",
     "ReplicaFleet",
     "FleetRouter",
+    "PooledTransport",
     "RouteFailed",
     "make_router_server",
+    "AioHTTPServer",
+    "make_aio_server",
     "ProgramRegistry",
     "DEFAULT_WIDTHS",
     "SERVE_STAGES",

@@ -1,10 +1,16 @@
 """``python -m psrsigsim_tpu.serve`` — the simulation serving daemon.
 
-Starts the dynamic-batching request engine behind the stdlib HTTP JSON
-API (:mod:`psrsigsim_tpu.serve.http`) and prints ONE machine-parseable
-ready line to stdout (``{"ready": true, "port": ...}``) once the socket
-is bound and warmup (if any) finished — the contract the subprocess
-test runner (tests/serve_runner.py) and shell scripts wait on.
+Starts the dynamic-batching request engine behind an HTTP JSON API and
+prints ONE machine-parseable ready line to stdout (``{"ready": true,
+"port": ...}``) once the socket is bound and warmup (if any) finished —
+the contract the subprocess test runner (tests/serve_runner.py) and
+shell scripts wait on.  ``--frontend`` selects the connection layer:
+``threaded`` (stdlib ``ThreadingHTTPServer``, one thread per
+connection — the fallback) or ``aio`` (the selectors event loop,
+:mod:`psrsigsim_tpu.serve.aio` — thousands of keep-alive connections
+on one loop; the C10k front end).  Responses are byte-identical across
+front ends (shared endpoint semantics in
+:mod:`psrsigsim_tpu.serve.http`).
 
 Example::
 
@@ -46,6 +52,18 @@ def main(argv=None):
                     help="comma-separated bucket widths")
     ap.add_argument("--max-queue", type=int, default=64)
     ap.add_argument("--batch-window-ms", type=float, default=2.0)
+    ap.add_argument("--frontend", default="threaded",
+                    choices=["threaded", "aio"],
+                    help="connection-handling layer: 'threaded' (stdlib "
+                         "thread-per-connection, the fallback) or 'aio' "
+                         "(selectors event loop — the C10k front end; "
+                         "PSS_AIO_MAX_CONNS / PSS_AIO_WORKERS tune it)")
+    ap.add_argument("--hot-mb", type=float, default=None,
+                    help="in-memory hot result tier budget in MiB "
+                         "(default: PSS_CACHE_HOT_MB or 256; 0 disables)")
+    ap.add_argument("--aio-max-conns", type=int, default=None,
+                    help="aio front end open-connection bound (default: "
+                         "PSS_AIO_MAX_CONNS or 10000)")
     ap.add_argument("--warmup", default=None,
                     help="JSON file: one spec (or a list) whose geometries "
                          "are compiled before the ready line")
@@ -83,7 +101,9 @@ def main(argv=None):
         batch_window_s=args.batch_window_ms / 1e3,
         verify_cache=args.verify_cache, faults=faults,
         compile_cache_dir=args.compile_cache_dir,
-        replica_id=args.replica_id)
+        replica_id=args.replica_id,
+        cache_hot_bytes=(None if args.hot_mb is None
+                         else int(args.hot_mb * (1 << 20))))
 
     if args.warmup:
         with open(args.warmup) as f:
@@ -91,12 +111,19 @@ def main(argv=None):
         for spec in specs if isinstance(specs, list) else [specs]:
             service.warmup(spec)
 
-    srv = make_server(args.host, args.port, service=service)
+    if args.frontend == "aio":
+        from .aio import AioHTTPServer
+
+        srv = AioHTTPServer(args.host, args.port, service=service,
+                            max_conns=args.aio_max_conns)
+    else:
+        srv = make_server(args.host, args.port, service=service)
 
     def _ready(s):
         print(json.dumps({"ready": True, "host": args.host,
                           "port": s.server_port,
                           "replica_id": args.replica_id,
+                          "frontend": args.frontend,
                           "cache": bool(args.cache_dir)}),
               file=real_stdout, flush=True)
 

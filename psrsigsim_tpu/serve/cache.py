@@ -56,6 +56,35 @@ until ``claim_timeout_s``.  The serving engine catches the re-raise and
 degrades to pass-through (the computed result is still served, just
 not cached) with a loud ``cache_put_errors`` metric.
 
+**In-memory hot tier (the viral-``spec_hash`` fix).**  Before this
+tier, a repeated identical request re-opened, re-read, and re-parsed
+its artifact from disk on EVERY hit.  ``ResultCache`` now keeps a
+byte-bounded in-process LRU (``hot_max_bytes``, default 256 MiB via
+``PSS_CACHE_HOT_MB``; 0 disables) of ``spec_hash -> (payload bytes,
+decoded read-only array)``:
+
+* **Populate** on commit (after — never before — the journal record
+  exists, so a SIGKILL or injected ENOSPC mid-commit can never leave a
+  hot entry for an unjournaled artifact) and on the first disk hit.
+* **Serve**: a hot hit performs zero disk reads, zero re-hashing, and
+  zero device calls; byte-identity to the disk path is structural —
+  the hot entry IS the committed payload bytes.
+* **Coherence with the cross-process journal discipline**: a hot entry
+  lives exactly as long as its journal record.  The journal-tail
+  refresh that applies a peer's ``drop`` (verify-drop) evicts the hot
+  entry in the same step, and a compaction inode change (full
+  re-replay) clears the whole tier — the same events that invalidate
+  the index invalidate the tier, nothing else does (a committed
+  artifact's bytes are immutable by content address).
+* **Evict** least-recently-used entries whenever the byte budget is
+  exceeded (``hot_evictions`` counts them; ``hot_bytes`` is the live
+  footprint).
+
+Even with the hot tier disabled, ``get`` memoizes the (inode, size)
+and decoded array of its LAST disk read: a repeated ``get`` of the
+same hash re-``stat``s (cheap) instead of re-opening and re-hashing,
+unless the journal tail moved or the file changed underneath.
+
 The ``serve.kill`` fault point fires here, immediately after a journal
 commit (and deliberately before the claim marker is released, so the
 relaunch path also proves orphan-claim cleanup); ``cache.contend``
@@ -80,11 +109,80 @@ import numpy as np
 
 from ..runtime.faults import crash_process, should_fire
 
-__all__ = ["ResultCache"]
+__all__ = ["ResultCache", "ByteLRU", "DEFAULT_HOT_MB"]
 
 _JOURNAL_NAME = "cache_journal.jsonl"
 _LOCK_NAME = "cache.lock"
 _CLAIMS_DIR = "claims"
+
+#: default in-memory hot-tier budget (MiB) when ``PSS_CACHE_HOT_MB``
+#: is unset and no explicit ``hot_max_bytes`` is passed
+DEFAULT_HOT_MB = 256.0
+
+
+def _env_hot_bytes():
+    try:
+        mb = float(os.environ.get("PSS_CACHE_HOT_MB", DEFAULT_HOT_MB))
+    except ValueError:
+        mb = DEFAULT_HOT_MB
+    return max(int(mb * (1 << 20)), 0)
+
+
+class ByteLRU:
+    """A byte-bounded LRU map (NOT thread-safe — callers hold their own
+    lock).  Values are ``(nbytes, payload)`` conceptually; the caller
+    supplies the byte cost at put time so the same container serves the
+    cache hot tier (cost = artifact payload bytes) and the aio front
+    end's rendered-response memo (cost = body bytes).  A zero budget
+    disables storage entirely (every put is a no-op)."""
+
+    __slots__ = ("max_bytes", "bytes", "evictions", "_d")
+
+    def __init__(self, max_bytes):
+        self.max_bytes = int(max_bytes)
+        self.bytes = 0
+        self.evictions = 0
+        self._d = {}          # key -> (nbytes, value); insertion = LRU order
+
+    def __len__(self):
+        return len(self._d)
+
+    def __contains__(self, key):
+        return key in self._d
+
+    def get(self, key):
+        """The value for ``key`` (marked most-recently-used), or None."""
+        ent = self._d.pop(key, None)
+        if ent is None:
+            return None
+        self._d[key] = ent    # re-insert at MRU end
+        return ent[1]
+
+    def put(self, key, value, nbytes):
+        """Insert/replace ``key``; evicts LRU entries past the budget.
+        An entry larger than the whole budget is not stored at all."""
+        nbytes = int(nbytes)
+        if self.max_bytes <= 0 or nbytes > self.max_bytes:
+            self.pop(key)
+            return
+        self.pop(key)
+        self._d[key] = (nbytes, value)
+        self.bytes += nbytes
+        while self.bytes > self.max_bytes:
+            old_key = next(iter(self._d))
+            old_bytes, _ = self._d.pop(old_key)
+            self.bytes -= old_bytes
+            self.evictions += 1
+
+    def pop(self, key):
+        ent = self._d.pop(key, None)
+        if ent is not None:
+            self.bytes -= ent[0]
+        return None if ent is None else ent[1]
+
+    def clear(self):
+        self._d.clear()
+        self.bytes = 0
 
 
 class ResultCache:
@@ -110,10 +208,22 @@ class ResultCache:
     compact_min_dead : int
         Dead journal records (drops/supersedes) tolerated before the
         open path compacts the journal.
+    hot_max_bytes : int, optional
+        Byte budget for the in-memory hot tier (module docstring).
+        Default: ``PSS_CACHE_HOT_MB`` MiB (256 when unset); 0 disables
+        the tier (the last-read memo still applies).
+    hot_tail_check_s : float
+        Coherence heartbeat for hot/memo hits: at most once per this
+        interval, a hit ``stat``s the journal (one syscall, no read)
+        and folds any peer-appended tail in — the disk path detected a
+        peer's verify-drop by the artifact file vanishing, and a tier
+        that never touches the file needs this bounded-staleness check
+        instead.  0 checks on every hit (tests).
     """
 
     def __init__(self, cache_dir, verify=False, faults=None,
-                 claim_timeout_s=5.0, compact_min_dead=64):
+                 claim_timeout_s=5.0, compact_min_dead=64,
+                 hot_max_bytes=None, hot_tail_check_s=0.05):
         self.cache_dir = str(cache_dir)
         self.results_dir = os.path.join(self.cache_dir, "results")
         self.claims_dir = os.path.join(self.cache_dir, _CLAIMS_DIR)
@@ -138,8 +248,24 @@ class ResultCache:
         self.compacted = 0     # dead journal records dropped at open
         self.claim_breaks = 0  # stale claims this process broke
         self.write_errors = 0  # commits aborted by OSError (ENOSPC, ...)
+        # in-memory hot tier: spec hash -> (payload bytes, read-only
+        # ndarray), LRU by payload bytes, coherent with the journal
+        # (every index invalidation path evicts here too)
+        self._hot = ByteLRU(_env_hot_bytes() if hot_max_bytes is None
+                            else int(hot_max_bytes))
+        self.hot_tail_check_s = float(hot_tail_check_s)
+        self._last_tail_check = 0.0
+        self.hot_hits = 0
+        self.disk_hits = 0     # hits that had to read the artifact file
+        self.memo_hits = 0     # hits served from the last-read memo
+        # last disk read, for hot-disabled repeat gets: (hash, inode,
+        # size, array) — valid while the file stats match and the entry
+        # is still indexed
+        self._last_read = None
+        self.tmp_sweeps = 0    # dead writers' partial tmps removed at open
         with self._lock, self._flocked():
             self._open_journal_locked()
+        self._sweep_dead_tmps()
         if verify:
             self.verify_all()
 
@@ -199,7 +325,13 @@ class ResultCache:
         if e == "put":
             self._index[rec["hash"]] = rec
         elif e == "drop":
+            # a verify-drop kills the hot entry and the read memo with
+            # the index record: hot-tier coherence IS index coherence
             self._index.pop(rec["hash"], None)
+            self._hot.pop(rec["hash"])
+            if self._last_read is not None \
+                    and self._last_read[0] == rec["hash"]:
+                self._last_read = None
 
     def _compact_locked(self, dead):
         """Rewrite the journal with live records only: temp + fsync +
@@ -238,6 +370,12 @@ class ResultCache:
             self._index = {}
             self._journal_pos = 0
             self._journal_ino = st.st_ino
+            # a peer compacted (or replaced) the journal: conservative
+            # full invalidation of the hot tier and read memo — live
+            # entries re-enter on their next hit, dead ones must not
+            # survive the re-replay
+            self._hot.clear()
+            self._last_read = None
         if st.st_size == self._journal_pos:
             return
         with open(self.journal_path, "rb") as f:
@@ -254,6 +392,25 @@ class ResultCache:
             pos += len(line)
             self._apply_record(rec)
         self._journal_pos = pos
+
+    def _tail_heartbeat_locked(self):
+        """Bounded-staleness coherence for hot/memo hits: at most once
+        per ``hot_tail_check_s``, one journal ``stat`` (no read unless
+        the tail actually moved) folds peer appends in — so a peer's
+        verify-drop evicts our hot entry within the heartbeat window
+        even when every local lookup is a hit and the miss-path refresh
+        never runs.  Caller holds the thread lock."""
+        now = time.monotonic()
+        if now - self._last_tail_check < self.hot_tail_check_s:
+            return
+        self._last_tail_check = now
+        try:
+            st = os.stat(self.journal_path)
+        except FileNotFoundError:
+            return
+        if (st.st_ino != self._journal_ino
+                or st.st_size != self._journal_pos):
+            self._refresh_locked()
 
     def _append_record_locked(self, rec):
         """One fsync'd journal append as a single ``write`` on an
@@ -278,6 +435,36 @@ class ResultCache:
         os.fsync(self._journal_f.fileno())
         self._journal_pos = os.stat(self.journal_path).st_size
         self._journal_ino = os.fstat(self._journal_f.fileno()).st_ino
+
+    def _sweep_dead_tmps(self):
+        """Remove artifact tmp files whose writing PROCESS is gone — a
+        writer SIGKILLed mid-``put`` (before its atomic rename) leaves
+        ``<hash>.npy.<pid>.<tid>.tmp`` behind, invisible to readers but
+        flagged by leak audits forever.  The tmp name carries the
+        writer's pid, so a dead pid identifies an orphan with
+        certainty; a LIVE writer's tmp is never touched."""
+        try:
+            names = os.listdir(self.results_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".tmp"):
+                continue
+            parts = name.split(".")
+            try:               # <hash>.npy.<pid>.<tid>.tmp
+                pid = int(parts[-3])
+            except (ValueError, IndexError):
+                continue
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                with contextlib.suppress(OSError):
+                    os.unlink(os.path.join(self.results_dir, name))
+                    self.tmp_sweeps += 1
+            except PermissionError:
+                pass           # alive under another uid: not ours to reap
 
     # -- verify ------------------------------------------------------------
 
@@ -305,6 +492,10 @@ class ResultCache:
                 with self._flocked():
                     for h in bad:
                         del self._index[h]
+                        self._hot.pop(h)
+                        if self._last_read is not None \
+                                and self._last_read[0] == h:
+                            self._last_read = None
                         self._append_record_locked({"e": "drop", "hash": h})
                         try:
                             os.unlink(self._artifact_path(h))
@@ -333,32 +524,67 @@ class ResultCache:
             return len(self._index)
 
     def get(self, h):
-        """The cached artifact for spec hash ``h`` (a numpy array), or
-        None on miss.  A miss refreshes the index from the journal tail
-        first, so commits by peer replicas over the shared dir are
-        served without any restart.  A hit never touches the device —
-        the serving engine's device-call counter is asserted against
-        exactly this."""
+        """The cached artifact for spec hash ``h`` (a read-only numpy
+        array), or None on miss.  Tier order: in-memory hot tier (zero
+        syscalls), last-read memo (one ``stat``), disk (read + decode,
+        then populate the hot tier).  A miss refreshes the index from
+        the journal tail first, so commits by peer replicas over the
+        shared dir are served without any restart.  A hit never touches
+        the device — the serving engine's device-call counter is
+        asserted against exactly this."""
         with self._lock:
             rec = self._index.get(h)
             if rec is None:
                 self._refresh_locked()
                 rec = self._index.get(h)
-        if rec is None:
-            with self._lock:
+            else:
+                self._tail_heartbeat_locked()
+                rec = self._index.get(h)
+            if rec is None:
                 self.misses += 1
-            return None
+                return None
+            ent = self._hot.get(h)
+            if ent is not None:
+                self.hits += 1
+                self.hot_hits += 1
+                return ent[1]
+            memo = self._last_read
+        if memo is not None and memo[0] == h:
+            # hot tier disabled (or entry evicted) but this very hash
+            # was the last disk read: re-validate with one cheap stat
+            # instead of re-opening and re-decoding the artifact
+            try:
+                st = os.stat(self._artifact_path(h))
+            except OSError:
+                st = None
+            if (st is not None and st.st_ino == memo[1]
+                    and st.st_size == memo[2]):
+                with self._lock:
+                    if h in self._index:    # not dropped meanwhile
+                        self.hits += 1
+                        self.memo_hits += 1
+                        return memo[3]
         try:
-            arr = np.load(self._artifact_path(h))
+            path = self._artifact_path(h)
+            with open(path, "rb") as f:
+                data = f.read()
+            st = os.stat(path)
+            arr = np.load(io.BytesIO(data))
         except (OSError, ValueError):
             # artifact vanished/torn since open: behave like a miss and
             # drop the index entry so the result is recomputed, not 500'd
             with self._lock:
                 self._index.pop(h, None)
+                self._hot.pop(h)
                 self.misses += 1
             return None
+        arr = arr.view()
+        arr.flags.writeable = False   # hot entries are shared across hits
         with self._lock:
             self.hits += 1
+            self.disk_hits += 1
+            self._hot.put(h, (data, arr), len(data))
+            self._last_read = (h, st.st_ino, st.st_size, arr)
         return arr
 
     def _claim(self, h):
@@ -480,6 +706,13 @@ class ResultCache:
                         self._append_record_locked(rec)
                         self._index[h] = rec
                         self._puts += 1
+                        # hot-populate ONLY once the journal record is
+                        # durable: a writer killed (or ENOSPC'd) before
+                        # this point leaves no hot entry for an
+                        # unjournaled artifact
+                        ro = array.view()
+                        ro.flags.writeable = False
+                        self._hot.put(h, (payload, ro), len(payload))
                 rec = self._index[h]
                 puts = self._puts
             # serve.kill: die AFTER the durable commit but BEFORE the
@@ -529,7 +762,17 @@ class ResultCache:
                     "dropped": self.dropped, "puts": self._puts,
                     "compacted": self.compacted,
                     "claim_breaks": self.claim_breaks,
-                    "write_errors": self.write_errors}
+                    "write_errors": self.write_errors,
+                    # tier counters: the c10k smoke gates "a hot hit
+                    # performs zero disk reads" on exactly these
+                    "hot_hits": self.hot_hits,
+                    "disk_hits": self.disk_hits,
+                    "memo_hits": self.memo_hits,
+                    "hot_entries": len(self._hot),
+                    "hot_bytes": self._hot.bytes,
+                    "hot_max_bytes": self._hot.max_bytes,
+                    "hot_evictions": self._hot.evictions,
+                    "tmp_sweeps": self.tmp_sweeps}
 
     def close(self):
         with self._lock:

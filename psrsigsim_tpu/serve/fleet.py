@@ -140,12 +140,21 @@ class ReplicaFleet:
                  autoscale=False, min_replicas=None, max_replicas=None,
                  scale_up_queue_frac=None, scale_down_queue_frac=None,
                  scale_up_p95_s=None, scale_interval_s=0.5,
-                 scale_up_cooldown_s=None, scale_down_cooldown_s=None):
+                 scale_up_cooldown_s=None, scale_down_cooldown_s=None,
+                 frontend="threaded", hot_mb=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if frontend not in ("threaded", "aio"):
+            raise ValueError(f"frontend must be 'threaded' or 'aio', "
+                             f"got {frontend!r}")
         self.n_replicas = int(n_replicas)
         self.cache_dir = str(cache_dir)
         self.host = host
+        # per-replica connection layer: "aio" runs every replica on the
+        # selectors event loop (serve/aio.py); "threaded" is the stdlib
+        # fallback.  The chaos/elastic proofs run under BOTH.
+        self.frontend = str(frontend)
+        self.hot_mb = None if hot_mb is None else float(hot_mb)
         self.widths = tuple(int(w) for w in widths)
         self.max_queue = int(max_queue)
         self.batch_window_ms = float(batch_window_ms)
@@ -332,7 +341,10 @@ class ReplicaFleet:
                "--replica-id", str(i),
                "--widths", ",".join(str(w) for w in self.widths),
                "--max-queue", str(self.max_queue),
-               "--batch-window-ms", str(self.batch_window_ms)]
+               "--batch-window-ms", str(self.batch_window_ms),
+               "--frontend", self.frontend]
+        if self.hot_mb is not None:
+            cmd += ["--hot-mb", str(self.hot_mb)]
         if self.compile_cache_dir:
             cmd += ["--compile-cache-dir", self.compile_cache_dir]
         if self.warmup_path:
@@ -497,6 +509,7 @@ class ReplicaFleet:
         depth = 0
         capacity = 0
         p95 = 0.0
+        conns = 0
         for h, sup in members:
             if not sup.alive():
                 continue   # dead/restarting: neither capacity nor depth
@@ -506,10 +519,14 @@ class ReplicaFleet:
             depth += int(h.get("queue_depth", 0))
             capacity += int(h.get("max_queue", self.max_queue))
             p95 = max(p95, float(h.get("request_p95_s", 0.0)))
+            # connection pressure (aio front ends report it): queue
+            # depth alone cannot see thousands of open-but-waiting
+            # sockets piling onto one replica
+            conns += int(h.get("open_connections", 0))
         frac = depth / capacity if capacity else 0.0
         return {"queue_frac": round(frac, 4), "queue_depth": depth,
                 "capacity": capacity, "p95_s": round(p95, 6),
-                "active": n_active}
+                "open_connections": conns, "active": n_active}
 
     def _autoscale_loop(self):
         """Hysteresis control loop (module docstring): up when the queue

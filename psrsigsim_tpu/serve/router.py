@@ -41,6 +41,14 @@ replicas over one shared cache":
   submits are async: restarting a merely-busy replica sheds capacity
   exactly when it is scarce.
 
+* **Pooled keep-alive upstreams** — the default transport is a
+  :class:`PooledTransport`: up to ``PSS_ROUTER_POOL_SIZE`` persistent
+  HTTP/1.1 connections per replica, reused across forwards (no fresh
+  TCP setup per request), with stale-socket single-retry and
+  breaker-aware eviction — a breaker opening closes the ejected
+  replica's pooled sockets within the breaker window, so no cached
+  route outlives the ejection.
+
 Chaos points (armed only via an explicit FaultPlan): ``replica.kill``
 SIGKILLs the routed replica right *before* the configured request is
 forwarded — the hardest-case mid-traffic death, proving the re-route +
@@ -56,13 +64,16 @@ single server at one address.
 
 from __future__ import annotations
 
+import collections
 import hashlib
+import http.client
 import json
 import os
 import signal
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -70,7 +81,8 @@ from ..runtime.faults import should_fire
 from .service import RequestRejected
 from .spec import canonicalize, spec_hash
 
-__all__ = ["FleetRouter", "RouteFailed", "make_router_server"]
+__all__ = ["FleetRouter", "RouteFailed", "make_router_server",
+           "PooledTransport"]
 
 
 def _env_float(name, default):
@@ -116,10 +128,13 @@ class RouteFailed(RuntimeError):
 
 
 def _http_transport(method, url, body, timeout):
-    """Default transport: one HTTP exchange -> ``(status, json dict)``.
-    Transport-level failures (refused, reset, timed out) propagate as
-    OSError/URLError — the router's failover trigger.  Injectable so
-    router logic is testable without sockets."""
+    """One-shot (non-pooled) transport: one HTTP exchange over a fresh
+    TCP connection -> ``(status, json dict)``.  Transport-level
+    failures (refused, reset, timed out) propagate as OSError/URLError
+    — the router's failover trigger.  Injectable so router logic is
+    testable without sockets.  The router's DEFAULT is now
+    :class:`PooledTransport`; this remains for tests and for callers
+    that explicitly want connection-per-request semantics."""
     headers = {"Content-Type": "application/json"} if body else {}
     req = urllib.request.Request(url, data=body, headers=headers,
                                  method=method)
@@ -132,6 +147,168 @@ def _http_transport(method, url, body, timeout):
         except (ValueError, OSError):
             payload = {"error": str(e)}
         return e.code, payload
+
+
+class PooledTransport:
+    """Keep-alive upstream connection pool: the router's default
+    transport.
+
+    Every forwarded request used to pay a fresh ``http.client`` TCP
+    setup (connect + slow-start + teardown) per exchange; under load
+    that is both per-request latency and a steady churn of TIME_WAIT
+    sockets.  This transport keeps up to ``pool_size`` persistent
+    HTTP/1.1 connections per replica endpoint and reuses them across
+    requests:
+
+    * **Checkout/checkin** is LIFO (the warmest socket first); a pooled
+      socket idle past ``idle_timeout_s`` is closed instead of reused.
+    * **Stale-socket retry**: a REUSED connection that dies before any
+      response bytes (the peer reaped it between requests) is retried
+      ONCE on a fresh connection — the standard keep-alive discipline —
+      so a benign idle-reap never counts as a replica failure.  A fresh
+      connection's failure propagates immediately (the failover
+      trigger).
+    * **Breaker-aware eviction**: :meth:`evict` closes every pooled
+      socket for an endpoint and bumps its epoch, so sockets checked
+      out before the eviction are closed at checkin instead of
+      re-entering the pool — when a replica's circuit breaker opens,
+      the router evicts its pool entry and no stale socket to the
+      ejected replica outlives the breaker window.
+
+    Thread-safe; one instance per router (it is per-destination
+    state, like the breakers).
+    """
+
+    def __init__(self, pool_size=None, idle_timeout_s=30.0):
+        self.pool_size = int(pool_size if pool_size is not None
+                             else _env_float("PSS_ROUTER_POOL_SIZE", 4))
+        self.idle_timeout_s = float(idle_timeout_s)
+        self._lock = threading.Lock()
+        self._pools = {}    # netloc -> deque of (conn, t_checkin)
+        self._epoch = {}    # netloc -> eviction epoch
+        self.hits = 0           # exchanges on a reused socket
+        self.misses = 0         # fresh TCP connects
+        self.stale_retries = 0  # reused-socket deaths retried fresh
+        self.evictions = 0      # sockets closed by evict()
+        self.idle_closed = 0    # sockets closed as past idle_timeout_s
+
+    @staticmethod
+    def _netloc(url):
+        return urllib.parse.urlsplit(url).netloc
+
+    def _checkout(self, netloc):
+        """A pooled live connection (warmest first) or None; returns
+        ``(conn, epoch)``."""
+        now = time.monotonic()
+        with self._lock:
+            epoch = self._epoch.get(netloc, 0)
+            q = self._pools.get(netloc)
+            while q:
+                conn, t = q.pop()
+                if now - t <= self.idle_timeout_s:
+                    self.hits += 1
+                    return conn, epoch
+                self.idle_closed += 1
+                conn.close()
+            self.misses += 1
+            return None, epoch
+
+    def _checkin(self, netloc, conn, epoch):
+        with self._lock:
+            if self._epoch.get(netloc, 0) != epoch:
+                # evicted (breaker opened) while this socket was in
+                # flight: close instead of resurrecting a route to an
+                # ejected replica
+                self.evictions += 1
+                conn.close()
+                return
+            q = self._pools.setdefault(netloc, collections.deque())
+            q.append((conn, time.monotonic()))
+            while len(q) > self.pool_size:
+                old, _ = q.popleft()
+                old.close()
+
+    def evict(self, base_url):
+        """Close every pooled socket for ``base_url``'s endpoint and
+        invalidate in-flight checkins (breaker-open hand-off)."""
+        netloc = self._netloc(base_url)
+        with self._lock:
+            self._epoch[netloc] = self._epoch.get(netloc, 0) + 1
+            q = self._pools.pop(netloc, None)
+            conns = [c for c, _ in q] if q else []
+            self.evictions += len(conns)
+        for c in conns:
+            c.close()
+
+    def open_count(self, base_url):
+        """Pooled (idle) sockets currently held for an endpoint — the
+        c10k harness asserts this hits zero within the breaker window
+        after an ejection."""
+        with self._lock:
+            q = self._pools.get(self._netloc(base_url))
+            return len(q) if q else 0
+
+    def close(self):
+        with self._lock:
+            pools, self._pools = self._pools, {}
+        for q in pools.values():
+            for conn, _ in q:
+                conn.close()
+
+    def stats(self):
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "stale_retries": self.stale_retries,
+                    "evictions": self.evictions,
+                    "idle_closed": self.idle_closed,
+                    "pooled": {n: len(q)
+                               for n, q in self._pools.items() if q}}
+
+    def __call__(self, method, url, body, timeout):
+        parsed = urllib.parse.urlsplit(url)
+        netloc = parsed.netloc
+        path = parsed.path or "/"
+        if parsed.query:
+            path += "?" + parsed.query
+        headers = {"Content-Type": "application/json"} if body else {}
+        for attempt in (0, 1):
+            conn, epoch = self._checkout(netloc)
+            reused = conn is not None
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    parsed.hostname, parsed.port, timeout=timeout)
+            else:
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError) as err:
+                conn.close()
+                # a TIMEOUT is a slow replica, not a reaped idle socket:
+                # retrying it would cost a second full timeout per
+                # forward and double-submit the request — propagate so
+                # the breaker/failover sees the slowness immediately
+                if (reused and attempt == 0
+                        and not isinstance(err, TimeoutError)):
+                    # the peer reaped this idle socket between requests:
+                    # retry once on a fresh connection before calling
+                    # the replica dead
+                    with self._lock:
+                        self.stale_retries += 1
+                    continue
+                if isinstance(err, OSError):
+                    raise
+                raise ConnectionError(
+                    f"{type(err).__name__}: {err}") from err
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(netloc, conn, epoch)
+            return resp.status, json.loads(data)
+        raise ConnectionError(f"pooled transport retry exhausted for {url}")
 
 
 class FleetRouter:
@@ -154,7 +331,15 @@ class FleetRouter:
         self._faults = faults
         self.default_timeout_s = float(default_timeout_s)
         self.retry_after_s = float(retry_after_s)
-        self._transport = transport if transport is not None else _http_transport
+        if transport is not None:
+            self._transport = transport
+            self._pool = (transport if isinstance(transport,
+                                                  PooledTransport) else None)
+        else:
+            # the default: pooled persistent keep-alive upstreams —
+            # every forward no longer pays a fresh TCP setup
+            self._pool = PooledTransport()
+            self._transport = self._pool
         # circuit-breaker tunables (env-overridable, arg wins):
         #   fails     — consecutive transport failures that open it
         #   reset_s   — open dwell before the half-open probe
@@ -308,7 +493,9 @@ class FleetRouter:
 
     def _record_failure(self, rid):
         """One transport failure: consecutive-failure counting opens the
-        breaker; a failed half-open probe reopens it immediately."""
+        breaker; a failed half-open probe reopens it immediately.
+        Returns True when this failure OPENED (or reopened) the breaker
+        so the caller can evict the replica's pooled sockets."""
         with self._lock:
             b = self._breakers.setdefault(rid, _Breaker())
             probe_failed = b.probing or b.state == "half_open"
@@ -318,12 +505,23 @@ class FleetRouter:
                 b.state = "open"
                 b.opened_at = time.monotonic()
                 b.reopens += 1
-            elif b.state == "closed" and b.fails >= self.breaker_fails:
+                return True
+            if b.state == "closed" and b.fails >= self.breaker_fails:
                 b.state = "open"
                 b.opened_at = time.monotonic()
                 b.reason = "errors"
                 b.ejections += 1
                 self.ejections += 1
+                return True
+            return False
+
+    def _evict_pooled(self, url):
+        """Breaker-aware pool hygiene: when a replica's breaker opens,
+        close its pooled keep-alive sockets (and invalidate in-flight
+        checkins) so no cached route to an ejected replica survives the
+        breaker window."""
+        if self._pool is not None and url is not None:
+            self._pool.evict(url)
 
     # -- request path ------------------------------------------------------
 
@@ -428,7 +626,8 @@ class FleetRouter:
                 # device execution.
                 attempts.append((rid, f"{type(err).__name__}: {err}"))
                 excluded.add(rid)
-                self._record_failure(rid)
+                if self._record_failure(rid):
+                    self._evict_pooled(url)
                 with self._lock:
                     self.failovers += 1
                 continue
@@ -445,7 +644,8 @@ class FleetRouter:
                 # exactly as sick as one refusing connections: count it
                 # toward the breaker instead of poisoning the latency
                 # EWMA with near-zero "successes"
-                self._record_failure(rid)
+                if self._record_failure(rid):
+                    self._evict_pooled(url)
             elif status in (429, 503):
                 # backpressure says the replica is BUSY, not slow or
                 # broken: release any probe slot but keep the ~instant
@@ -454,7 +654,10 @@ class FleetRouter:
                 # actually-working peers look like latency outliers
                 self._clear_probe(rid)
             else:
-                self._record_success(rid, time.monotonic() - t_fwd)
+                if self._record_success(rid, time.monotonic() - t_fwd):
+                    # latency ejection: the gray replica's pooled
+                    # sockets go with its routing eligibility
+                    self._evict_pooled(url)
             with self._lock:
                 self.routed += 1
                 self.per_replica[rid] = self.per_replica.get(rid, 0) + 1
@@ -495,7 +698,7 @@ class FleetRouter:
 
     def stats(self):
         with self._lock:
-            return {
+            out = {
                 "routed": self.routed,
                 "forwarded": self.forwarded,
                 "failovers": self.failovers,
@@ -507,6 +710,15 @@ class FleetRouter:
                 "breakers": {rid: b.snapshot()
                              for rid, b in self._breakers.items()},
             }
+        if self._pool is not None:
+            out["pool"] = self._pool.stats()
+        return out
+
+    def close(self):
+        """Release pooled upstream sockets (fd hygiene — the c10k
+        harness asserts the fd census returns to baseline)."""
+        if self._pool is not None:
+            self._pool.close()
 
 
 class _RouterHandler(BaseHTTPRequestHandler):

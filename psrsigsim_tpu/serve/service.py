@@ -102,7 +102,8 @@ class RequestFailed(Exception):
 
 class _Request:
     __slots__ = ("id", "canonical", "geom_hash", "status", "error",
-                 "result", "cached", "done", "t_submit", "deadline")
+                 "result", "cached", "done", "t_submit", "deadline",
+                 "callbacks")
 
     def __init__(self, rid, canonical, geom_hash, deadline):
         self.id = rid
@@ -115,6 +116,7 @@ class _Request:
         self.done = threading.Event()
         self.t_submit = time.perf_counter()
         self.deadline = deadline
+        self.callbacks = []   # fired once, on terminal transition
 
 
 class SimulationService:
@@ -141,12 +143,16 @@ class SimulationService:
         Shared timer object; by default the service owns one.
     faults : FaultPlan, optional
         Arms ``serve.kill`` / ``serve.reject`` (tests only).
+    cache_hot_bytes : int, optional
+        In-memory hot-tier byte budget forwarded to
+        :class:`~psrsigsim_tpu.serve.ResultCache` (default: the
+        ``PSS_CACHE_HOT_MB`` env, 256 MiB; 0 disables the tier).
     """
 
     def __init__(self, cache_dir=None, widths=DEFAULT_WIDTHS, max_queue=64,
                  batch_window_s=0.002, retry_after_s=0.5, telemetry=None,
                  faults=None, verify_cache=False, compile_cache_dir=None,
-                 max_done=1024, replica_id=None):
+                 max_done=1024, replica_id=None, cache_hot_bytes=None):
         import os
 
         if compile_cache_dir is None and cache_dir is not None:
@@ -156,7 +162,8 @@ class SimulationService:
         self.registry = ProgramRegistry(widths,
                                         compile_cache_dir=compile_cache_dir)
         self.cache = (ResultCache(cache_dir, verify=verify_cache,
-                                  faults=faults)
+                                  faults=faults,
+                                  hot_max_bytes=cache_hot_bytes)
                       if cache_dir is not None else None)
         self.timers = (telemetry if telemetry is not None
                        else StageTimers(extra_stages=SERVE_STAGES,
@@ -166,6 +173,11 @@ class SimulationService:
         self.retry_after_s = float(retry_after_s)
         self.max_done = int(max_done)
         self._faults = faults
+        # the serving front end (AioHTTPServer registers itself here):
+        # health()/metrics() fold its stats() in so the fleet health
+        # poll and the autoscaler see connection pressure, not just
+        # queue depth
+        self.frontend = None
         self._cond = threading.Condition()
         self._queue = deque()
         self._requests = OrderedDict()
@@ -310,6 +322,36 @@ class SimulationService:
                 self._svc_ewma = (self._svc_alpha * float(per_request_s)
                                   + (1.0 - self._svc_alpha) * self._svc_ewma)
 
+    def _finish(self, req):
+        """Terminal transition: set the done event and fire registered
+        completion callbacks exactly once.  The Condition's lock is an
+        RLock, so this is safe from call sites already holding it;
+        callbacks run on the completing thread (the batcher) and must
+        only schedule work, never block."""
+        with self._cond:
+            req.done.set()
+            cbs, req.callbacks = req.callbacks, []
+        for fn in cbs:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - a bad callback must not
+                pass           # poison the batch that completed it
+
+    def on_done(self, rid, fn):
+        """Register ``fn()`` to run when request ``rid`` reaches a
+        terminal state (done/expired/error).  Fires immediately on the
+        caller's thread when the request already completed — or when
+        the id is unknown to the bounded status table (its result, if
+        any, lives in the cache; the caller resolves via
+        :meth:`result`).  This is the aio front end's no-thread-blocked
+        wait path."""
+        with self._cond:
+            req = self._requests.get(rid)
+            if req is not None and not req.done.is_set():
+                req.callbacks.append(fn)
+                return
+        fn()
+
     def _coalesce(self, rid, deadline):
         """Coalesce onto an identical in-flight/completed request
         (content-addressed identity): returns its status, or None when
@@ -393,7 +435,8 @@ class SimulationService:
             shed = self.shed
             degraded = self.cache_degraded
         reg = self.registry.stats()
-        return {
+        fe = self.frontend
+        out = {
             "ok": True,
             "replica_id": self.replica_id,
             "uptime_s": round(time.time() - self.started_at, 3),
@@ -412,6 +455,15 @@ class SimulationService:
             "programs": reg["programs"],
             "compile_counts": reg["compile_counts"],
         }
+        if fe is not None:
+            # connection pressure for the fleet health poll and the
+            # autoscaler's load_signal(): queue depth alone cannot see
+            # ten thousand idle-but-open sockets
+            fes = fe.stats()
+            out["frontend"] = fes
+            out["open_connections"] = int(
+                fes.get("open_connections", 0))
+        return out
 
     def metrics(self):
         """One JSON-ready dict: stage timers (with latency percentiles),
@@ -441,6 +493,8 @@ class SimulationService:
         out["programs"] = self.registry.stats()
         if self.cache is not None:
             out["cache"] = self.cache.stats()
+        if self.frontend is not None:
+            out["frontend"] = self.frontend.stats()
         return out
 
     # -- the batcher -------------------------------------------------------
@@ -482,7 +536,7 @@ class SimulationService:
                 r.error = "deadline exceeded before execution"
                 with self._cond:
                     self.expired += 1
-                r.done.set()
+                self._finish(r)
             else:
                 alive.append(r)
         return alive
@@ -519,7 +573,7 @@ class SimulationService:
                 r.result = arr
                 r.cached = True
                 r.status = "done"
-                r.done.set()
+                self._finish(r)
                 self.timers.add("request",
                                 time.perf_counter() - r.t_submit)
                 with self._cond:
@@ -596,7 +650,7 @@ class SimulationService:
                     self.timers.gauge("cache_degraded", 1)
             r.result = arr
             r.status = "done"
-            r.done.set()
+            self._finish(r)
             self.timers.add("request", now - r.t_submit)
         with self._cond:
             self.served += len(batch)
@@ -620,7 +674,7 @@ class SimulationService:
                     if not r.done.is_set():
                         r.status = "error"
                         r.error = f"{type(err).__name__}: {err}"
-                        r.done.set()
+                        self._finish(r)
 
     def _evict_terminal(self):
         """Bound the status table: oldest TERMINAL requests beyond

@@ -50,16 +50,44 @@ line on stdout:
     config11_elastic: req/s and p99 at 1x/2x/4x of a nominal load for a
     FIXED single-replica fleet vs an AUTOSCALED (min 1, max N) fleet,
     429s counted, scale events reported.
+
+``--mode c10k``
+    The PR 13 front-door proof.  (1) a SOLO threaded baseline serves a
+    small hot spec set, restarts, and records every ``GET /result``
+    response's raw BODY bytes; (2) an aio fleet over a fresh cache is
+    warmed, restarted (so every result is served through the cache
+    tiers, not the in-process status table), and a selectors-based
+    client opens THOUSANDS of concurrent keep-alive connections
+    (default 10000, rlimit-clamped) driving GET storms: one warm round,
+    a steady round whose per-replica ``disk_hits`` and ``device_calls``
+    deltas must be ZERO (hot tier + zero-copy body memo carry all of
+    it), and a chaos round with a replica SIGKILLed mid-storm (clients
+    reconnect to survivors; the supervisor restarts the corpse) — every
+    response byte-identical to the solo threaded baseline; (3) a
+    router leg proves pooled keep-alive upstreams (pool hits > 0) and
+    breaker-aware eviction: after a replica dies, the breaker opens and
+    its pooled sockets are closed within the breaker window; (4) fd
+    hygiene — the harness's fd census returns to baseline after drain.
+
+``--mode c10k-bench``
+    config13_c10k: req/s and client-side p99 at 100/1k/10k concurrent
+    keep-alive connections, threaded vs aio front end (threaded capped
+    at ``--threaded-max``), hot-tier hit rate reported.
 """
 
 import argparse
 import hashlib
 import json
 import os
+import selectors
 import shutil
+import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
+import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 # mirror tests/conftest.py BEFORE jax initializes (replica subprocesses
@@ -140,6 +168,7 @@ def run_chaos(args):
     solo_cache = os.path.join(out_dir, "solo_cache")
     fleet = ReplicaFleet(1, solo_cache, widths=widths,
                          warmup_path=warm_path, quorum=1,
+                         frontend=args.frontend,
                          log_dir=os.path.join(out_dir, "logs_solo"))
     fleet.start()
     try:
@@ -161,6 +190,7 @@ def run_chaos(args):
     plan = FaultPlan(os.path.join(out_dir, "scratch"), plan_spec)
     fleet = ReplicaFleet(args.replicas, fleet_cache, widths=widths,
                          warmup_path=warm_path, quorum=1,
+                         frontend=args.frontend,
                          log_dir=os.path.join(out_dir, "logs_fleet"))
     fleet.start()
     try:
@@ -461,6 +491,7 @@ def run_elastic(args):
         kw.setdefault("quorum", 1)
         kw.setdefault("warmup_path", warm_path)
         kw.setdefault("compile_cache_dir", compile_cache)
+        kw.setdefault("frontend", args.frontend)
         kw.setdefault("log_dir", os.path.join(out_dir, "logs"))
         return ReplicaFleet(n, cache, **kw)
 
@@ -869,13 +900,700 @@ def run_elastic_bench(args):
 
 
 # ---------------------------------------------------------------------------
+# C10k front-end proof (PR 13)
+# ---------------------------------------------------------------------------
+
+#: smaller geometry than BASE_SPEC (2 chans x 256 phase bins): the c10k
+#: storms move tens of thousands of response bodies through one host,
+#: so the per-response JSON must be kilobytes, not tens of kilobytes
+C10K_SPEC = dict(BASE_SPEC, nchan=2, sample_rate_mhz=0.0512)
+
+
+def c10k_spec(j):
+    """The j-th hot-set spec (distinct content hashes)."""
+    return dict(C10K_SPEC, seed=7000 + j, dm=12.0 + 0.25 * j)
+
+
+def _raise_nofile():
+    """Lift the soft fd limit to the hard limit; returns the new soft
+    limit (the client + both server processes each need one fd per
+    connection)."""
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        except (ValueError, OSError):
+            pass
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    return soft
+
+
+def _fd_count():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def _http_get_raw(url, timeout=30.0):
+    """One GET -> raw BODY bytes (the byte-identity fingerprint domain
+    of the c10k proof is the exact bytes on the wire)."""
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _http_post(url, body_dict, timeout=300.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body_dict).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class C10kClient:
+    """Selectors-based keep-alive load client: N persistent
+    connections, each bound to one request id, driven in synchronous
+    waves (send one GET, read the full response, repeat).  A dead
+    connection (refused / reset / EOF — the mid-storm replica kill)
+    reconnects to a CURRENT live target and resends its in-flight
+    request.  Single-threaded; ``responses`` is readable from other
+    threads (the chaos killer watches it for its trigger point)."""
+
+    def __init__(self, targets_fn, conns, rid_of, expect=None,
+                 deadline_s=300.0):
+        self.targets_fn = targets_fn   # () -> [(host, port), ...] LIVE
+        self.n = int(conns)
+        self.rid_of = rid_of           # conn index -> request id
+        self.expect = expect           # rid -> body sha256 (None: record)
+        self.deadline_s = float(deadline_s)
+        self.sel = selectors.DefaultSelector()
+        self.conns = {}                # fd -> per-conn state dict
+        self.by_index = {}             # conn index -> state dict
+        self.responses = 0             # completed responses (monotonic)
+        self.reconnects = 0
+        self.errors = []
+        self.lats = []
+        self.bodies = {}               # rid -> last observed body sha
+        self.peak_open = 0
+
+    # -- connection management --------------------------------------------
+
+    def _target(self, i):
+        ts = self.targets_fn()
+        if not ts:
+            raise RuntimeError("no live targets")
+        return ts[i % len(ts)]
+
+    def _connect(self, i, st=None):
+        if st is None:
+            st = {"i": i, "rid": self.rid_of(i)}
+            self.by_index[i] = st
+        st.update(sock=None, fd=-1, connected=False, inflight=False,
+                  out=b"", buf=bytearray())
+        host, port = self._target(i + self.reconnects)
+        s = socket.socket()
+        s.setblocking(False)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        s.connect_ex((host, port))     # EINPROGRESS expected
+        st["sock"], st["fd"] = s, s.fileno()
+        self.conns[st["fd"]] = st
+        self.sel.register(s, selectors.EVENT_WRITE, st)
+        return st
+
+    def _drop(self, st):
+        self.conns.pop(st["fd"], None)
+        try:
+            self.sel.unregister(st["sock"])
+        except (KeyError, ValueError):
+            pass
+        try:
+            st["sock"].close()
+        except OSError:
+            pass
+
+    def _reconnect(self, st):
+        resend = st["inflight"]
+        self._drop(st)
+        self.reconnects += 1
+        self._connect(st["i"], st)
+        if resend:
+            st["inflight"] = True      # resent once the connect lands
+        return st
+
+    def open_all(self):
+        """Establish all N connections (staggered; refused connects
+        retry against current live targets)."""
+        t_end = time.monotonic() + self.deadline_s
+        started = 0
+        while time.monotonic() < t_end:
+            live = sum(1 for st in self.by_index.values()
+                       if st["connected"])
+            if started < self.n and started - live < 1000:
+                burst = min(self.n - started, 1000)
+                for i in range(started, started + burst):
+                    self._connect(i)
+                started += burst
+            if live >= self.n:
+                break
+            for key, mask in self.sel.select(0.1):
+                st = key.data
+                if not st["connected"] and mask & selectors.EVENT_WRITE:
+                    err = st["sock"].getsockopt(socket.SOL_SOCKET,
+                                                socket.SO_ERROR)
+                    if err:
+                        self._reconnect(st)
+                        continue
+                    st["connected"] = True
+                    self.sel.modify(st["sock"], selectors.EVENT_READ, st)
+        established = sum(1 for st in self.by_index.values()
+                          if st["connected"])
+        self.peak_open = max(self.peak_open, established)
+        if established < self.n:
+            self.errors.append(
+                f"open_all: {established}/{self.n} connections")
+        return established
+
+    # -- the storm ---------------------------------------------------------
+
+    def _request_bytes(self, st):
+        return (f"GET /result/{st['rid']} HTTP/1.1\r\n"
+                f"Host: c10k\r\n\r\n").encode()
+
+    def _send(self, st):
+        st["inflight"] = True
+        st["buf"].clear()
+        st["t_send"] = time.perf_counter()
+        st["out"] = self._request_bytes(st)
+        self._pump_out(st)
+
+    def _pump_out(self, st):
+        try:
+            n = st["sock"].send(st["out"])
+            st["out"] = st["out"][n:]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            return self._reconnect(st)
+        mask = selectors.EVENT_READ | (
+            selectors.EVENT_WRITE if st["out"] else 0)
+        try:
+            self.sel.modify(st["sock"], mask, st)
+        except (KeyError, ValueError):
+            pass
+
+    def _on_response(self, st, status, body):
+        self.lats.append(time.perf_counter() - st["t_send"])
+        self.responses += 1
+        st["inflight"] = False
+        sha = hashlib.sha256(body).hexdigest()
+        self.bodies[st["rid"]] = sha
+        if status != 200:
+            self.errors.append(
+                f"conn {st['i']}: HTTP {status} {body[:120]!r}")
+        elif self.expect is not None \
+                and self.expect.get(st["rid"]) != sha:
+            self.errors.append(
+                f"conn {st['i']}: body sha mismatch for "
+                f"{st['rid'][:12]}")
+
+    def _read(self, st):
+        try:
+            data = st["sock"].recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return None
+        except OSError:
+            self._reconnect(st)
+            return None
+        if not data:
+            self._reconnect(st)
+            return None
+        st["buf"] += data
+        head_end = st["buf"].find(b"\r\n\r\n")
+        if head_end < 0:
+            return None
+        head = bytes(st["buf"][:head_end]).decode("latin-1", "replace")
+        clen = 0
+        for ln in head.split("\r\n")[1:]:
+            k, _, v = ln.partition(":")
+            if k.strip().lower() == "content-length":
+                try:
+                    clen = int(v.strip())
+                except ValueError:
+                    pass
+        total = head_end + 4 + clen
+        if len(st["buf"]) < total:
+            return None
+        try:
+            status = int(head.split("\r\n")[0].split()[1])
+        except (IndexError, ValueError):
+            status = 0
+        body = bytes(st["buf"][head_end + 4:total])
+        del st["buf"][:total]
+        return status, body
+
+    def storm(self, waves):
+        """Every connection performs ``waves`` sequential request/
+        response exchanges.  Returns per-storm summary (elapsed,
+        responses, req/s)."""
+        remaining = {}
+        for st in self.by_index.values():
+            remaining[st["i"]] = int(waves)
+            if st["connected"]:
+                self._send(st)
+            else:
+                st["inflight"] = True   # sent as soon as connect lands
+        t0 = time.monotonic()
+        t_end = t0 + self.deadline_s
+        done0 = self.responses
+        target = len(remaining) * int(waves)
+        while self.responses - done0 < target:
+            if time.monotonic() > t_end:
+                self.errors.append(
+                    f"storm timeout: {self.responses - done0}/{target}")
+                break
+            for key, mask in self.sel.select(0.2):
+                st = key.data
+                if not st["connected"]:
+                    if mask & selectors.EVENT_WRITE:
+                        err = st["sock"].getsockopt(
+                            socket.SOL_SOCKET, socket.SO_ERROR)
+                        if err:
+                            self._reconnect(st)
+                            continue
+                        st["connected"] = True
+                        self.sel.modify(st["sock"],
+                                        selectors.EVENT_READ, st)
+                        if st["inflight"]:
+                            self._send(st)   # resend the lost request
+                    continue
+                if mask & selectors.EVENT_WRITE and st["out"]:
+                    self._pump_out(st)
+                if mask & selectors.EVENT_READ:
+                    got = self._read(st)
+                    if got is None:
+                        continue
+                    self._on_response(st, *got)
+                    remaining[st["i"]] -= 1
+                    if remaining[st["i"]] > 0:
+                        self._send(st)
+        elapsed = time.monotonic() - t0
+        done = self.responses - done0
+        return {"waves": int(waves), "responses": done,
+                "elapsed_s": round(elapsed, 3),
+                "req_per_sec": round(done / elapsed, 1) if elapsed else 0.0}
+
+    def p99_s(self):
+        if not self.lats:
+            return None
+        vals = sorted(self.lats)
+        return round(vals[max(0, int(0.99 * len(vals)) - 1)], 5)
+
+    def close_all(self):
+        for st in list(self.by_index.values()):
+            self._drop(st)
+        self.by_index.clear()
+        self.sel.close()
+
+
+def _endpoint_targets(fleet):
+    """() -> live (host, port) pairs, for the client's reconnect
+    routing."""
+    def targets():
+        out = []
+        for _rid, url in fleet.endpoints():
+            hostport = url.split("//", 1)[1]
+            host, _, port = hostport.partition(":")
+            out.append((host, int(port)))
+        return out
+    return targets
+
+
+def _replica_metrics(fleet):
+    """{replica_id: /metrics dict} for every live replica."""
+    out = {}
+    for rid, url in fleet.endpoints():
+        out[rid] = _fetch_json(url + "/metrics")
+    return out
+
+
+def run_c10k(args):
+    from psrsigsim_tpu.runtime import FaultPlan
+    from psrsigsim_tpu.serve import (FleetRouter, ReplicaFleet,
+                                     ResultCache, canonicalize, spec_hash)
+
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    warm_path = os.path.join(out_dir, "warm.json")
+    with open(warm_path, "w") as f:
+        json.dump(C10K_SPEC, f)
+    compile_cache = os.path.join(out_dir, "compile_cache")
+    log_dir = os.path.join(out_dir, "logs")
+    # every replica must admit the full storm (plus health/metrics
+    # pollers); the env is inherited by the spawned servers
+    os.environ.setdefault("PSS_AIO_MAX_CONNS", str(args.conns + 2000))
+
+    soft = _raise_nofile()
+    conns = min(args.conns, max(soft - 2000, 64))
+    n_specs = args.c10k_specs
+    specs = {j: c10k_spec(j) for j in range(n_specs)}
+    rids = {j: spec_hash(canonicalize(specs[j])) for j in range(n_specs)}
+
+    def mk_fleet(n, cache, frontend, **kw):
+        kw.setdefault("widths", (1,))
+        kw.setdefault("quorum", 1)
+        kw.setdefault("warmup_path", warm_path)
+        kw.setdefault("compile_cache_dir", compile_cache)
+        kw.setdefault("log_dir", log_dir)
+        return ReplicaFleet(n, cache, frontend=frontend, **kw)
+
+    def warm_and_restart(cache, frontend, n_after=1):
+        """Commit the hot set through one replica, drain, relaunch
+        ``n_after`` replicas over the same cache with verify — every
+        later GET is served through the cache tiers, the storm's
+        steady-state path."""
+        fleet = mk_fleet(1, cache, frontend)
+        fleet.start()
+        try:
+            (_, url), = fleet.endpoints()
+            post_shas = {}
+            for j, spec in specs.items():
+                status, resp = _http_post(
+                    url + "/simulate", dict(spec, wait=args.deadline),
+                    timeout=args.deadline)
+                if status != 200 or resp.get("status") != "done":
+                    raise RuntimeError(
+                        f"warm POST {j}: HTTP {status} {resp}")
+                post_shas[j] = _profile_sha(resp)
+        finally:
+            fleet.drain()
+        fleet = mk_fleet(n_after, cache, frontend, verify_cache=True)
+        fleet.start()
+        return fleet, post_shas
+
+    verdict = {"mode": "c10k", "conns": conns, "n_specs": n_specs,
+               "frontend": "aio", "ok": False}
+
+    # -- solo threaded baseline (the byte oracle) ------------------------
+    solo_cache = os.path.join(out_dir, "solo_cache")
+    fleet, solo_post = warm_and_restart(solo_cache, "threaded")
+    try:
+        (_, url), = fleet.endpoints()
+        solo_shas = {}
+        solo_profile_shas = {}
+        for j in range(n_specs):
+            status, body = _http_get_raw(url + f"/result/{rids[j]}",
+                                         timeout=args.deadline)
+            if status != 200:
+                return {"ok": False, "stage": "solo",
+                        "error": f"GET {j}: HTTP {status}"}
+            solo_shas[rids[j]] = hashlib.sha256(body).hexdigest()
+            solo_profile_shas[j] = _profile_sha(json.loads(body))
+    finally:
+        fleet.drain()
+    if solo_profile_shas != solo_post:
+        return {"ok": False, "stage": "solo",
+                "error": "restart GET profiles != warm POST profiles"}
+
+    # -- the storm: aio fleet, cache-tier serving, kill mid-storm --------
+    aio_cache = os.path.join(out_dir, "aio_cache")
+    fd0 = _fd_count()
+    fleet, aio_post = warm_and_restart(aio_cache, "aio",
+                                       n_after=args.storm_replicas)
+    storm = {}
+    try:
+        if aio_post != solo_post:
+            return {"ok": False, "stage": "aio-warm",
+                    "error": "aio POST profiles != threaded POST"}
+        client = C10kClient(_endpoint_targets(fleet), conns,
+                            rid_of=lambda i: rids[i % n_specs],
+                            expect=solo_shas, deadline_s=args.deadline)
+        storm["established"] = client.open_all()
+        storm["warm"] = client.storm(1)
+        m1 = _replica_metrics(fleet)
+        storm["steady"] = client.storm(args.steady_waves)
+        m2 = _replica_metrics(fleet)
+        # the zero-disk-read gate: between warm and steady snapshots,
+        # repeated hits moved ONLY through the hot tier and body memo
+        disk_delta = sum(m2[r]["cache"]["disk_hits"] for r in m2) \
+            - sum(m1[r]["cache"]["disk_hits"] for r in m1 if r in m2)
+        device_calls = sum(m2[r]["programs"]["device_calls"] for r in m2)
+        # a steady-state hit lands in the cache hot tier OR the front
+        # end's rendered-body memo (which intercepts before the cache);
+        # together they must carry the whole round
+        hot_delta = sum(
+            m2[r]["cache"]["hot_hits"]
+            + m2[r]["frontend"]["body_memo"]["hits"] for r in m2) \
+            - sum(m1[r]["cache"]["hot_hits"]
+                  + m1[r]["frontend"]["body_memo"]["hits"]
+                  for r in m1 if r in m2)
+        memo_hits = sum(m2[r]["frontend"]["body_memo"]["hits"]
+                        for r in m2)
+        peak_server = sum(m2[r]["frontend"]["peak_connections"]
+                          for r in m2)
+        storm["disk_hits_delta_steady"] = disk_delta
+        storm["hot_hits_delta_steady"] = hot_delta
+        storm["device_calls"] = device_calls
+        storm["body_memo_hits"] = memo_hits
+        storm["peak_server_connections"] = peak_server
+        storm["loop_lag_s"] = max(
+            m2[r]["frontend"]["loop_lag_s"] for r in m2)
+        # chaos wave: SIGKILL the newest replica once the wave is ~20%
+        # in; its clients reconnect to survivors and the supervisor
+        # restarts the corpse
+        victim = max(r for r, _ in fleet.endpoints())
+        base_responses = client.responses
+        trigger = conns * args.steady_waves // 5
+
+        def _killer():
+            t_end = time.monotonic() + args.deadline
+            while time.monotonic() < t_end:
+                if client.responses - base_responses >= trigger:
+                    fleet.kill_replica(victim, signal.SIGKILL)
+                    return
+                time.sleep(0.02)
+
+        kt = threading.Thread(target=_killer, daemon=True)
+        kt.start()
+        storm["chaos"] = client.storm(args.steady_waves)
+        kt.join(args.deadline)
+        storm["reconnects"] = client.reconnects
+        storm["errors"] = client.errors[:20]
+        storm["n_errors"] = len(client.errors)
+        storm["p99_s"] = client.p99_s()
+        storm["responses_total"] = client.responses
+        storm["peak_client_open"] = client.peak_open
+        client.close_all()
+        # front-end census drains once the clients hang up
+        drained = False
+        t_end = time.monotonic() + 30.0
+        while time.monotonic() < t_end:
+            try:
+                open_now = sum(
+                    m["frontend"]["open_connections"]
+                    for m in _replica_metrics(fleet).values())
+            except OSError:
+                open_now = -1
+            if 0 <= open_now <= 2:
+                drained = True
+                break
+            time.sleep(0.5)
+        storm["server_conns_drained"] = drained
+        # recovery: the killed replica comes back
+        recovered = True
+        t_end = time.monotonic() + args.deadline
+        while fleet.healthy_count() < args.storm_replicas:
+            if time.monotonic() > t_end:
+                recovered = False
+                break
+            time.sleep(0.2)
+        storm["recovered"] = recovered
+        storm["restarts"] = sum(fleet.health()["restarts"].values())
+    finally:
+        fleet.drain()
+    verdict["storm"] = storm
+    verdict["storm_audit"] = _audit_cache(aio_cache, ResultCache)
+
+    # -- router leg: pooled upstreams + breaker-aware eviction -----------
+    pool_cache = os.path.join(out_dir, "pool_cache")
+    fleet, _ = warm_and_restart(pool_cache, "aio", n_after=2)
+    pool = {}
+    try:
+        victim = max(r for r, _ in fleet.endpoints())
+        victim_url = dict(fleet.endpoints())[victim]
+        # blackhole (network partition, process alive): forwards to the
+        # victim raise ConnectionError while it keeps its endpoint —
+        # the one failure mode where ONLY the breaker (not liveness
+        # supervision) removes it, so its pooled sockets stay open
+        # until breaker-aware eviction closes them
+        scratch = os.path.join(out_dir, "pool_scratch")
+        plan = FaultPlan(scratch, {"route.blackhole":
+                                   {"match": str(victim), "times": 32}})
+        router = FleetRouter(fleet, breaker_fails=3, breaker_reset_s=30.0)
+        # route the hot set twice: the second pass MUST reuse pooled
+        # sockets (pool hits)
+        for _pass in range(2):
+            shas_p, _, rej_p, errs_p = _drive_wave(
+                router, {j: specs[j] for j in range(n_specs)},
+                threads=4, deadline_s=args.deadline)
+            if errs_p or rej_p or len(shas_p) != n_specs:
+                pool["errors"] = errs_p
+                break
+            mism = [j for j in shas_p
+                    if shas_p[j] != solo_profile_shas[j]]
+            if mism:
+                pool["mismatches"] = mism
+                break
+        st0 = router.stats()
+        pool["pool_hits"] = st0["pool"]["hits"]
+        pool["pool_misses"] = st0["pool"]["misses"]
+        pooled_before = router._pool.open_count(victim_url)
+        router._faults = plan
+        t_fail = time.monotonic()
+        # drive the hot set (failover serves everything) until the
+        # victim's breaker opens; the blackhole budget caps the cost
+        opened = False
+        t_end = time.monotonic() + args.deadline
+        errs_k, shas_k_all = [], {}
+        while time.monotonic() < t_end and not opened:
+            shas_k, _, _, ek = _drive_wave(
+                router, {j: specs[j] for j in range(n_specs)},
+                threads=2, deadline_s=args.deadline)
+            errs_k += ek
+            shas_k_all.update(shas_k)
+            b = router.stats()["breakers"].get(victim)
+            opened = b is not None and b["state"] == "open"
+        window_s = time.monotonic() - t_fail
+        pool["breaker_opened"] = opened
+        pool["open_window_s"] = round(window_s, 3)
+        pool["victim_pooled_before"] = pooled_before
+        pool["victim_pooled_after"] = router._pool.open_count(victim_url)
+        pool["kill_errors"] = errs_k
+        pool["kill_mismatches"] = [
+            j for j in shas_k_all
+            if shas_k_all[j] != solo_profile_shas[j]]
+        pool["blackholed"] = router.stats()["blackholed"]
+        pool["stats"] = router.stats()
+        router.close()
+    finally:
+        fleet.drain()
+    verdict["pool"] = pool
+
+    fd_after = _fd_count()
+    verdict["fd_baseline"] = fd0
+    verdict["fd_after"] = fd_after
+    verdict["fd_leak"] = max(fd_after - fd0, 0)
+
+    storm_ok = (not storm.get("n_errors")
+                and storm.get("established", 0) >= conns
+                and storm.get("disk_hits_delta_steady", 1) == 0
+                and storm.get("device_calls", 1) == 0
+                and storm.get("hot_hits_delta_steady", 0)
+                >= conns * args.steady_waves
+                and storm.get("peak_server_connections", 0) >= conns
+                and storm.get("reconnects", 0) >= 1
+                and storm.get("restarts", 0) >= 1
+                and storm.get("recovered") and storm.get(
+                    "server_conns_drained"))
+    pool_ok = (pool.get("pool_hits", 0) > 0
+               and pool.get("breaker_opened")
+               and pool.get("victim_pooled_before", 0) >= 1
+               and pool.get("victim_pooled_after", 1) == 0
+               and not pool.get("errors") and not pool.get("mismatches")
+               and not pool.get("kill_errors")
+               and not pool.get("kill_mismatches"))
+    audit = verdict["storm_audit"]
+    verdict["byte_identical"] = not storm.get("n_errors")
+    verdict["storm_ok"] = storm_ok
+    verdict["pool_ok"] = pool_ok
+    verdict["ok"] = bool(
+        storm_ok and pool_ok and verdict["fd_leak"] <= 16
+        and audit["lost_commits"] == 0 and not audit["leaked_claims"]
+        and not audit["leaked_tmps"])
+    return verdict
+
+
+def run_c10k_bench(args):
+    """config13_c10k: req/s and client p99 at 100/1k/10k concurrent
+    keep-alive connections, threaded vs aio (threaded capped at
+    ``--threaded-max`` — past it the thread-per-connection model is the
+    thing being demonstrated, not measured)."""
+    from psrsigsim_tpu.serve import (ReplicaFleet, ResultCache,  # noqa: F401
+                                     canonicalize, spec_hash)
+
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    warm_path = os.path.join(out_dir, "warm.json")
+    with open(warm_path, "w") as f:
+        json.dump(C10K_SPEC, f)
+    compile_cache = os.path.join(out_dir, "compile_cache")
+    os.environ.setdefault("PSS_AIO_MAX_CONNS", str(args.conns + 2000))
+    soft = _raise_nofile()
+    top = min(args.conns, max(soft - 2000, 64))
+    levels = sorted({min(lv, top) for lv in (100, 1000, top)})
+    n_specs = args.c10k_specs
+    specs = {j: c10k_spec(j) for j in range(n_specs)}
+    rids = {j: spec_hash(canonicalize(specs[j])) for j in range(n_specs)}
+
+    results = {"threaded": {}, "aio": {}}
+    hot_rate = {}
+    for frontend in ("threaded", "aio"):
+        cache = os.path.join(out_dir, f"{frontend}_cache")
+        fleet = ReplicaFleet(1, cache, widths=(1,), quorum=1,
+                             warmup_path=warm_path,
+                             compile_cache_dir=compile_cache,
+                             frontend=frontend,
+                             log_dir=os.path.join(out_dir, "logs"))
+        fleet.start()
+        try:
+            (_, url), = fleet.endpoints()
+            for j, spec in specs.items():
+                status, resp = _http_post(
+                    url + "/simulate", dict(spec, wait=args.deadline),
+                    timeout=args.deadline)
+                if status != 200:
+                    raise RuntimeError(f"warm {frontend} {j}: {status}")
+        finally:
+            fleet.drain()
+        fleet = ReplicaFleet(1, cache, widths=(1,), quorum=1,
+                             warmup_path=warm_path, verify_cache=True,
+                             compile_cache_dir=compile_cache,
+                             frontend=frontend,
+                             log_dir=os.path.join(out_dir, "logs"))
+        fleet.start()
+        try:
+            for lv in levels:
+                if frontend == "threaded" and lv > args.threaded_max:
+                    continue
+                client = C10kClient(
+                    _endpoint_targets(fleet), lv,
+                    rid_of=lambda i: rids[i % n_specs],
+                    deadline_s=args.deadline)
+                client.open_all()
+                s = client.storm(args.bench_waves)
+                s["p99_s"] = client.p99_s()
+                s["errors"] = len(client.errors)
+                client.close_all()
+                results[frontend][str(lv)] = s
+            m = _replica_metrics(fleet)
+            mm = next(iter(m.values()))
+            c = mm["cache"]
+            fe_hits = mm.get("frontend", {}).get(
+                "body_memo", {}).get("hits", 0)
+            hot = c["hot_hits"] + c["memo_hits"] + fe_hits
+            served = hot + c["disk_hits"]
+            hot_rate[frontend] = round(hot / served, 4) if served else None
+        finally:
+            fleet.drain()
+
+    verdict = {"mode": "c10k-bench", "levels": levels,
+               "threaded_max": args.threaded_max,
+               "bench_waves": args.bench_waves,
+               "threaded": results["threaded"], "aio": results["aio"],
+               "hot_hit_rate": hot_rate}
+    errs = sum(v["errors"] for fr in results.values() for v in fr.values())
+    verdict["errors"] = errs
+    verdict["ok"] = errs == 0
+    return verdict
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="chaos",
                     choices=["chaos", "cache-stress", "stress-worker",
-                             "elastic", "elastic-bench"])
+                             "elastic", "elastic-bench", "c10k",
+                             "c10k-bench"])
+    ap.add_argument("--frontend", default="threaded",
+                    choices=["threaded", "aio"],
+                    help="replica connection layer for chaos/elastic "
+                         "modes (the c10k modes pick their own)")
     ap.add_argument("--out", required=True,
                     help="work dir (chaos/stress) or cache dir (worker)")
     ap.add_argument("--replicas", type=int, default=2)
@@ -900,6 +1618,22 @@ def main(argv=None):
                     help="replica.slow injected latency (seconds)")
     ap.add_argument("--slow-times", type=int, default=4,
                     help="replica.slow shot budget")
+    # c10k knobs
+    ap.add_argument("--conns", type=int,
+                    default=int(os.environ.get("PSS_BENCH_C10K_CONNS",
+                                               "10000")),
+                    help="concurrent keep-alive connections "
+                         "(rlimit-clamped)")
+    ap.add_argument("--c10k-specs", type=int, default=8,
+                    help="hot-set size (distinct spec hashes)")
+    ap.add_argument("--storm-replicas", type=int, default=2)
+    ap.add_argument("--steady-waves", type=int, default=2,
+                    help="request waves per connection per storm round")
+    ap.add_argument("--bench-waves", type=int, default=3,
+                    help="waves per level in c10k-bench")
+    ap.add_argument("--threaded-max", type=int, default=1000,
+                    help="highest connection level the threaded "
+                         "front end is driven at in c10k-bench")
     args = ap.parse_args(argv)
 
     # keep stdout clean for the one-line verdict protocol
@@ -913,6 +1647,10 @@ def main(argv=None):
         verdict = run_elastic(args)
     elif args.mode == "elastic-bench":
         verdict = run_elastic_bench(args)
+    elif args.mode == "c10k":
+        verdict = run_c10k(args)
+    elif args.mode == "c10k-bench":
+        verdict = run_c10k_bench(args)
     else:
         verdict = run_stress_worker(args)
     print(json.dumps(verdict), file=real_stdout, flush=True)

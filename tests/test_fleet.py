@@ -67,6 +67,304 @@ class TestRetryJitter:
 
 
 # ---------------------------------------------------------------------------
+# in-memory hot tier (PR 13)
+# ---------------------------------------------------------------------------
+
+
+def _cache(d, **kw):
+    kw.setdefault("hot_tail_check_s", 0.0)   # deterministic coherence
+    return ResultCache(str(d), **kw)
+
+
+class TestHotTier:
+    def test_hot_hit_after_commit_reads_no_disk(self, tmp_path):
+        """An artifact committed by THIS process serves from memory:
+        zero disk reads, zero re-hashing (the viral-spec_hash fix)."""
+        c = _cache(tmp_path / "c", hot_max_bytes=1 << 20)
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        c.put("aa" * 32, arr)
+        for _ in range(3):
+            got = c.get("aa" * 32)
+            assert got.tobytes() == arr.tobytes()
+        s = c.stats()
+        assert s["hot_hits"] == 3 and s["disk_hits"] == 0
+        assert s["hot_entries"] == 1 and s["hot_bytes"] > 0
+        c.close()
+
+    def test_hot_and_disk_tiers_byte_identical(self, tmp_path):
+        """A fresh reader's first get decodes from disk; its second is
+        hot — and a hot-disabled reader re-reads from disk every time.
+        All three paths must produce identical bytes."""
+        d = tmp_path / "c"
+        w = _cache(d, hot_max_bytes=1 << 20)
+        arr = np.linspace(0, 1, 48, dtype=np.float32).reshape(4, 12)
+        w.put("bb" * 32, arr)
+        hot = w.get("bb" * 32)                     # committer: hot
+        r = _cache(d, hot_max_bytes=1 << 20)
+        disk = r.get("bb" * 32)                    # fresh: disk decode
+        hot2 = r.get("bb" * 32)                    # then hot
+        cold = _cache(d, hot_max_bytes=0)
+        nodisk = cold.get("bb" * 32)               # hot disabled
+        assert (hot.tobytes() == disk.tobytes() == hot2.tobytes()
+                == nodisk.tobytes() == arr.astype(np.float32).tobytes())
+        assert r.stats()["disk_hits"] == 1 and r.stats()["hot_hits"] == 1
+        assert cold.stats()["hot_hits"] == 0
+        for c in (w, r, cold):
+            c.close()
+
+    def test_disk_read_memo_skips_reopen_until_journal_moves(
+            self, tmp_path):
+        """The hot-disabled satellite: repeated gets of the SAME hash
+        must not re-open and re-decode the artifact — the (hash, inode,
+        size) memo of the last verified read serves them — until the
+        journal tail moves."""
+        d = tmp_path / "c"
+        w = _cache(d, hot_max_bytes=0)
+        arr = np.full((2, 8), 7.0, np.float32)
+        w.put("cc" * 32, arr)
+        r = _cache(d, hot_max_bytes=0)
+        assert r.get("cc" * 32) is not None        # disk read
+        assert r.get("cc" * 32) is not None        # memo
+        assert r.get("cc" * 32) is not None        # memo
+        s = r.stats()
+        assert s["disk_hits"] == 1 and s["memo_hits"] == 2
+        # journal tail moves (peer commit): memo for OTHER hash useless,
+        # but the same hash still serves (refresh keeps its record live)
+        w.put("dd" * 32, arr)
+        assert r.get("cc" * 32) is not None
+        w.close(), r.close()
+
+    def test_peer_verify_drop_evicts_hot_entry(self, tmp_path):
+        """Cross-process coherence: a peer's journaled verify-drop must
+        evict this process's hot entry (journal-tail heartbeat), not be
+        masked by it."""
+        d = tmp_path / "c"
+        a = _cache(d, hot_max_bytes=1 << 20)
+        arr = np.ones((3, 4), np.float32)
+        a.put("ee" * 32, arr)
+        assert a.get("ee" * 32) is not None        # hot in a
+        with open(os.path.join(str(d), "results", "ee" * 32 + ".npy"),
+                  "wb") as f:
+            f.write(b"torn")
+        # the relaunched-peer path: a fresh reader indexes the commit,
+        # re-hashes, finds the torn artifact, journals the drop
+        b = _cache(d, hot_max_bytes=1 << 20, verify=True)
+        assert b.dropped == 1
+        assert a.get("ee" * 32) is None            # heartbeat saw it
+        assert a.stats()["hot_entries"] == 0
+        a.close(), b.close()
+
+    def test_lru_byte_bound_under_concurrent_put_get(self, tmp_path):
+        """The byte budget holds under concurrent put/get from many
+        threads, every get returns correct bytes, and evictions are
+        counted."""
+        c = _cache(tmp_path / "c", hot_max_bytes=600)
+        arrs = {f"{i:02d}" * 32: np.full((3, 8), float(i), np.float32)
+                for i in range(12)}
+        errs = []
+
+        def worker(keys):
+            try:
+                for _ in range(5):
+                    for h in keys:
+                        c.put(h, arrs[h])
+                        got = c.get(h)
+                        if got is not None \
+                                and got.tobytes() != arrs[h].tobytes():
+                            errs.append(h)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        keys = list(arrs)
+        threads = [threading.Thread(target=worker,
+                                    args=(keys[i::3],))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        s = c.stats()
+        assert not errs
+        assert s["hot_bytes"] <= 600
+        assert s["hot_evictions"] > 0
+        # and the durable tier is intact underneath
+        for h in keys:
+            assert c.get(h).tobytes() == arrs[h].tobytes()
+        c.close()
+
+    def test_enospc_at_journal_leaves_no_hot_entry(self, tmp_path):
+        """The SIGKILL/ENOSPC-mid-commit pin: an artifact that never
+        reached the journal must have no hot entry — hot population
+        happens strictly AFTER the journal record is durable."""
+        plan = FaultPlan(str(tmp_path / "scratch"),
+                         {"cache.enospc": {"at": "journal", "times": 1}})
+        c = _cache(tmp_path / "c", faults=plan, hot_max_bytes=1 << 20)
+        with pytest.raises(OSError):
+            c.put("ff" * 32, np.ones(4, np.float32))
+        s = c.stats()
+        assert s["hot_entries"] == 0
+        assert c.get("ff" * 32) is None
+        c.close()
+
+    def test_dead_writer_tmp_swept_at_open(self, tmp_path):
+        """A SIGKILLed writer's partial artifact tmp (named with its
+        pid) is reaped at the next open; a LIVE writer's tmp is not."""
+        d = tmp_path / "c"
+        c = _cache(d)
+        c.put("aa" * 32, np.ones(4, np.float32))
+        c.close()
+        results = os.path.join(str(d), "results")
+        dead = os.path.join(results, f"{'bb' * 32}.npy.999999.1.tmp")
+        live = os.path.join(results, f"{'cc' * 32}.npy.{os.getpid()}.1.tmp")
+        for p in (dead, live):
+            with open(p, "wb") as f:
+                f.write(b"partial")
+        c2 = _cache(d)
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)        # we are alive: not ours to reap
+        assert c2.stats()["tmp_sweeps"] == 1
+        c2.close()
+        os.unlink(live)
+
+
+# ---------------------------------------------------------------------------
+# pooled keep-alive transport (PR 13)
+# ---------------------------------------------------------------------------
+
+
+class TestPooledTransport:
+    @pytest.fixture
+    def tiny_server(self):
+        """A minimal keep-alive JSON HTTP server (stdlib, no JAX)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"path": self.path}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_POST = do_GET
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{srv.server_port}"
+        srv.shutdown()
+        srv.server_close()
+
+    def test_second_request_reuses_pooled_socket(self, tiny_server):
+        from psrsigsim_tpu.serve.router import PooledTransport
+
+        tp = PooledTransport(pool_size=4)
+        s1, _ = tp("GET", tiny_server + "/a", None, 10)
+        s2, _ = tp("GET", tiny_server + "/b", None, 10)
+        assert s1 == s2 == 200
+        st = tp.stats()
+        assert st["misses"] == 1 and st["hits"] == 1
+        assert tp.open_count(tiny_server) == 1
+        tp.close()
+
+    def test_pool_size_cap(self, tiny_server):
+        from psrsigsim_tpu.serve.router import PooledTransport
+
+        tp = PooledTransport(pool_size=2)
+        # 4 concurrent checkouts -> 4 sockets; only 2 may be pooled
+        conns = [tp._checkout(tp._netloc(tiny_server)) for _ in range(4)]
+        import http.client
+        from urllib.parse import urlsplit
+
+        u = urlsplit(tiny_server)
+        for conn, epoch in conns:
+            if conn is None:
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=10)
+            tp._checkin(tp._netloc(tiny_server), conn, epoch)
+        assert tp.open_count(tiny_server) <= 2
+        tp.close()
+
+    def test_evict_closes_pooled_and_invalidates_inflight(
+            self, tiny_server):
+        from psrsigsim_tpu.serve.router import PooledTransport
+
+        tp = PooledTransport(pool_size=4)
+        tp("GET", tiny_server + "/a", None, 10)
+        assert tp.open_count(tiny_server) == 1
+        # an in-flight socket checked out BEFORE the eviction...
+        conn, epoch = tp._checkout(tp._netloc(tiny_server))
+        assert conn is not None
+        tp.evict(tiny_server)
+        assert tp.open_count(tiny_server) == 0
+        # ...is closed at checkin instead of re-entering the pool
+        tp._checkin(tp._netloc(tiny_server), conn, epoch)
+        assert tp.open_count(tiny_server) == 0
+        assert tp.stats()["evictions"] >= 1
+        tp.close()
+
+    def test_stale_pooled_socket_retries_once_then_raises(self):
+        """A pooled socket silently closed by the server (idle reap, a
+        restart): the reused-socket failure retries ONCE on a fresh
+        connection (keep-alive discipline) and succeeds invisibly; once
+        the server is truly gone, the fresh connection's failure
+        propagates — the failover trigger."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from psrsigsim_tpu.serve.router import PooledTransport
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+                # close WITHOUT advertising it: the client pools a
+                # socket the server has already abandoned — exactly
+                # the stale-reuse case
+                self.close_connection = True
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{srv.server_port}"
+        tp = PooledTransport(pool_size=4)
+        assert tp("GET", url + "/x", None, 10)[0] == 200
+        assert tp.open_count(url) == 1
+        # reuse hits the abandoned socket -> ONE invisible fresh retry
+        assert tp("GET", url + "/x", None, 10)[0] == 200
+        assert tp.stats()["stale_retries"] == 1
+        srv.shutdown()
+        srv.server_close()                 # listener gone: fresh conns fail
+        with pytest.raises((OSError, ConnectionError)):
+            tp("GET", url + "/x", None, 5)
+        tp.close()
+
+    def test_router_default_transport_is_pooled_with_stats(self):
+        class StubFleet:
+            def endpoints(self):
+                return []
+
+            def has_quorum(self):
+                return True
+
+        r = FleetRouter(StubFleet())
+        assert "pool" in r.stats()
+        r.close()
+
+
+# ---------------------------------------------------------------------------
 # cross-process cache commit discipline (tentpole)
 # ---------------------------------------------------------------------------
 
@@ -1112,3 +1410,43 @@ class TestFleetProofs:
         assert verdict["lost_commits"] == 0
         assert verdict["compile_ok"] is True
         assert verdict["kill_fired"] >= 1 and verdict["restarts"] >= 1
+
+    @pytest.mark.slow
+    def test_chaos_with_aio_frontend(self, tmp_path):
+        """The PR 13 gate: the replica-kill chaos proof passes
+        unchanged when every replica runs the selectors event-loop
+        front end instead of the threaded one."""
+        verdict, rc = _run_runner(
+            ["--mode", "chaos", "--out", str(tmp_path / "ca"),
+             "--frontend", "aio",
+             "--replicas", "2", "--requests", "6", "--kill-after", "2",
+             "--threads", "3"],
+            timeout=560)
+        assert rc == 0 and verdict["ok"], verdict
+        assert verdict["byte_identical"] is True
+        assert verdict["lost_commits"] == 0
+        assert verdict["kill_fired"] >= 1 and verdict["restarts"] >= 1
+
+    @pytest.mark.slow
+    def test_c10k_storm_byte_identity_and_fd_hygiene(self, tmp_path):
+        """The PR 13 acceptance pin, CI-sized (the full 10k-connection
+        storm runs in `make bench-c10k`): hundreds of concurrent
+        keep-alive connections through the aio front end, every
+        response byte-identical to a solo threaded baseline, zero disk
+        reads / device calls in steady state, a mid-storm replica kill
+        survived via client reconnects, pooled sockets to a
+        breaker-ejected replica closed, fd census restored."""
+        verdict, rc = _run_runner(
+            ["--mode", "c10k", "--out", str(tmp_path / "k"),
+             "--conns", "400", "--deadline", "240"],
+            timeout=560)
+        assert rc == 0 and verdict["ok"], verdict
+        assert verdict["byte_identical"] is True
+        storm = verdict["storm"]
+        assert storm["established"] >= 400
+        assert storm["disk_hits_delta_steady"] == 0
+        assert storm["device_calls"] == 0
+        assert storm["reconnects"] >= 1 and storm["recovered"]
+        assert verdict["pool"]["breaker_opened"]
+        assert verdict["pool"]["victim_pooled_after"] == 0
+        assert verdict["fd_leak"] <= 16

@@ -525,6 +525,187 @@ class TestHTTP:
 
 
 # ---------------------------------------------------------------------------
+# the aio (event-loop) front end
+# ---------------------------------------------------------------------------
+
+
+class TestAioFrontend:
+    @pytest.fixture
+    def servers(self, tmp_path):
+        """ONE SimulationService behind BOTH front ends at once: the
+        shared endpoint semantics (serve/http.py module functions) make
+        response bodies byte-identical across them by construction —
+        these tests pin it over real sockets."""
+        from psrsigsim_tpu.serve.aio import AioHTTPServer
+        from psrsigsim_tpu.serve.http import make_server
+
+        srv_t = make_server(port=0, cache_dir=str(tmp_path / "cache"),
+                            widths=(1, 8), batch_window_s=0.002)
+        svc = srv_t.service
+        svc.warmup(SPEC)
+        srv_a = AioHTTPServer(port=0, service=svc, max_conns=64)
+        for s in (srv_t, srv_a):
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+        srv_a._started.wait(5)
+        yield (f"http://127.0.0.1:{srv_t.server_port}",
+               f"http://127.0.0.1:{srv_a.server_port}", svc)
+        srv_a.shutdown()
+        srv_t.shutdown()
+        svc.close()
+        srv_a.server_close()
+        srv_t.server_close()
+
+    @staticmethod
+    def _raw(base, path, data=None, timeout=60):
+        req = urllib.request.Request(
+            base + path,
+            data=(json.dumps(data).encode() if data is not None else None),
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_result_and_error_bodies_byte_identical(self, servers):
+        base_t, base_a, _svc = servers
+        code, body = self._raw(base_t, "/simulate", dict(SPEC, wait=120))
+        assert code == 200
+        rid = json.loads(body)["id"]
+        for path in (f"/result/{rid}", f"/status/{rid}",
+                     "/result/" + "0" * 64, "/status/" + "0" * 64):
+            ct, bt = self._raw(base_t, path)
+            ca, ba = self._raw(base_a, path)
+            assert (ct, bt) == (ca, ba), path
+        # repeat /result through aio twice: the second serves the
+        # memoized zero-copy fragment and MUST still match threaded
+        ct, bt = self._raw(base_t, f"/result/{rid}")
+        ca, ba = self._raw(base_a, f"/result/{rid}")
+        assert bt == ba
+        # bad-spec errors too
+        ct, bt = self._raw(base_t, "/simulate", {"nchan": "x"})
+        ca, ba = self._raw(base_a, "/simulate", {"nchan": "x"})
+        assert ct == ca == 400 and bt == ba
+
+    def test_waited_post_through_aio(self, servers):
+        """A waited POST on the event loop blocks no worker thread
+        (completion-callback path) and returns the same body a
+        threaded waited POST would."""
+        base_t, base_a, _svc = servers
+        spec = dict(SPEC, seed=311)
+        ca, ba = self._raw(base_a, "/simulate", dict(spec, wait=120))
+        assert ca == 200 and json.loads(ba)["status"] == "done"
+        rid = json.loads(ba)["id"]
+        ct, bt = self._raw(base_t, f"/result/{rid}")
+        aa, ab = self._raw(base_a, f"/result/{rid}")
+        assert bt == ab
+
+    def test_keep_alive_pipelined_requests_in_order(self, servers):
+        _bt, base_a, _svc = servers
+        code, body = self._raw(base_a, "/simulate", dict(SPEC, seed=77,
+                                                         wait=120))
+        rid = json.loads(body)["id"]
+        import socket as socket_mod
+
+        host, port = base_a.split("//")[1].split(":")
+        s = socket_mod.create_connection((host, int(port)), timeout=30)
+        one = (f"GET /result/{rid} HTTP/1.1\r\nHost: t\r\n\r\n").encode()
+        s.sendall(one * 3)          # pipelined on one connection
+        buf = b""
+        deadline = time.time() + 30
+        while buf.count(b"HTTP/1.1 200") < 3 and time.time() < deadline:
+            chunk = s.recv(1 << 20)
+            if not chunk:
+                break
+            buf += chunk
+        s.close()
+        assert buf.count(b"HTTP/1.1 200") == 3
+
+    def test_connection_limit_rejects_with_503(self, tmp_path):
+        from psrsigsim_tpu.serve.aio import AioHTTPServer
+
+        svc = _service(tmp_path)
+        srv = AioHTTPServer(port=0, service=svc, max_conns=2)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        srv._started.wait(5)
+        import socket as socket_mod
+
+        held = [socket_mod.create_connection(("127.0.0.1",
+                                              srv.server_port))
+                for _ in range(2)]
+        try:
+            # the held pair must be ACCEPTED (not just queued) first
+            deadline = time.time() + 10
+            while len(srv._conns) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            s3 = socket_mod.create_connection(("127.0.0.1",
+                                               srv.server_port))
+            s3.settimeout(10)
+            data = s3.recv(4096)
+            assert b"503" in data and b"connection limit" in data
+            assert s3.recv(4096) == b""      # closed after the reply
+            s3.close()
+            assert srv.overflow_rejects >= 1
+        finally:
+            for s in held:
+                s.close()
+            srv.shutdown()
+            svc.close()
+            srv.server_close()
+
+    def test_malformed_request_line_gets_400(self, servers):
+        _bt, base_a, _svc = servers
+        import socket as socket_mod
+
+        host, port = base_a.split("//")[1].split(":")
+        s = socket_mod.create_connection((host, int(port)), timeout=10)
+        s.sendall(b"garbage\r\n\r\n")
+        data = s.recv(65536)
+        assert b"400" in data
+        s.close()
+
+    def test_frontend_gauges_in_health_and_metrics(self, servers):
+        _bt, base_a, svc = servers
+        code, body = self._raw(base_a, "/healthz")
+        h = json.loads(body)
+        assert code == 200 and h["frontend"]["kind"] == "aio"
+        assert "open_connections" in h
+        code, body = self._raw(base_a, "/metrics")
+        m = json.loads(body)
+        assert "frontend" in m and "loop_lag_s" in m["frontend"]
+        # the periodic tick exports gauges through the shared
+        # StageTimers API (the autoscaler's visibility path)
+        deadline = time.time() + 10
+        while (svc.timers.gauge_value("open_connections") is None
+               and time.time() < deadline):
+            self._raw(base_a, "/healthz")
+            time.sleep(0.05)
+        assert svc.timers.gauge_value("open_connections") is not None
+
+    def test_on_done_callback_semantics(self, tmp_path):
+        """on_done fires exactly once on terminal transition, and
+        immediately for already-done / unknown ids — the aio wait
+        path's contract."""
+        svc = _service(tmp_path)
+        try:
+            svc.warmup(SPEC)
+            rid, status = svc.submit(dict(SPEC, seed=9119))
+            fired = []
+            svc.on_done(rid, lambda: fired.append("a"))
+            svc.result(rid, timeout=120)
+            deadline = time.time() + 10
+            while not fired and time.time() < deadline:
+                time.sleep(0.01)
+            assert fired == ["a"]
+            svc.on_done(rid, lambda: fired.append("b"))   # already done
+            assert fired == ["a", "b"]
+            svc.on_done("0" * 64, lambda: fired.append("c"))  # unknown
+            assert fired == ["a", "b", "c"]
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
 # kill / resume (subprocess, PR-2 style)
 # ---------------------------------------------------------------------------
 
