@@ -44,9 +44,18 @@
 # shards, every label pinned bit-identical against the in-graph ground
 # truth, deterministic (seed, shard, epoch) shuffling, stage timers
 # naming the bottleneck (bench.py dataset_smoke).
+# `make integrity-smoke` is the silent-corruption gate: clean runs under
+# the full checksum lattice + 5% duplicate-execution audit are
+# false-positive-free and byte-identical to integrity-off at chunk
+# sizes {32,128,512}; injected device.sdc / host.corrupt / disk.bitrot
+# faults are detected, healed, and byte-identical to clean on the
+# dataset and serving producers (export/MC legs run in tier-1); the 5%
+# audit stays under a loose cost bound (bench.py integrity_smoke; the
+# honest cost numbers land in config14_integrity).
 
 .PHONY: lint test test-faults bench-export bench-mc serve-smoke \
-	bench-scenarios fleet-smoke elastic-smoke bench-c10k bench-dataset
+	bench-scenarios fleet-smoke elastic-smoke bench-c10k bench-dataset \
+	integrity-smoke
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -80,3 +89,6 @@ bench-c10k:
 
 bench-dataset:
 	JAX_PLATFORMS=cpu python bench.py --dataset-smoke
+
+integrity-smoke:
+	JAX_PLATFORMS=cpu python bench.py --integrity-smoke

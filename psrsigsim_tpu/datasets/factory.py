@@ -131,7 +131,8 @@ class DatasetFactory:
     # -- the run ------------------------------------------------------------
 
     def run(self, out_dir, chunk_size=256, resume=True, telemetry=None,
-            progress=None, faults=None, _stop_after_chunks=None):
+            progress=None, faults=None, integrity=None,
+            _stop_after_chunks=None):
         """Write (or resume) the corpus; returns a summary dict.
 
         Args:
@@ -151,7 +152,20 @@ class DatasetFactory:
             faults: optional
                 :class:`~psrsigsim_tpu.runtime.FaultPlan` (tests only;
                 arms the ``dataset.kill`` point — SIGKILL right after a
-                chunk's journal commit).
+                chunk's journal commit — and, with ``integrity``,
+                ``device.sdc`` / ``host.corrupt`` / ``disk.bitrot``).
+            integrity: the silent-corruption defense
+                (:mod:`psrsigsim_tpu.runtime.integrity`): ``None``
+                consults ``PSS_INTEGRITY`` (unset = off); when armed,
+                each chunk's device field buffers carry a combined
+                device-computed per-record digest re-checked on host
+                before encode (closing the fetch->encode window), a
+                deterministic ``audit_frac`` of chunks duplicate-
+                executes through a fresh instance of the record
+                program, disagreements heal by verified re-execution
+                (byte-identical corpora — healing never re-draws), and
+                journal commit lines carry the device-attested ``dig``
+                claim.
             _stop_after_chunks: TESTING hook — stop cleanly after N
                 fresh chunk commits (an interrupted run without a
                 subprocess); returns None.
@@ -171,6 +185,11 @@ class DatasetFactory:
         layout = sampler.field_layout()
         names = [n for n, _, _ in layout]
         width = sampler.chunk_width(chunk_size)
+
+        from ..runtime.integrity import resolve_integrity
+
+        checker = resolve_integrity(integrity, fingerprint=self.fingerprint,
+                                    faults=faults)
 
         os.makedirs(out_dir, exist_ok=True)
         self._check_manifest(out_dir, resume)
@@ -229,6 +248,15 @@ class DatasetFactory:
         def _dispatch(start):
             t0 = _time.perf_counter()
             dev = sampler.dispatch(start, width)
+            if checker is not None:
+                from ..runtime.integrity import device_fields_digest_rows
+
+                # device.sdc arm perturbs the FIRST field buffer before
+                # the combined digest attests the chunk; the digest
+                # rides the fetch as one extra tiny array
+                dev = (checker.apply_sdc(dev[0], ident=start),) \
+                    + tuple(dev[1:])
+                dev = dev + (device_fields_digest_rows(dev),)
             telemetry.add("dispatch", _time.perf_counter() - t0)
             return dev
 
@@ -249,7 +277,72 @@ class DatasetFactory:
             telemetry.add("encode", _time.perf_counter() - t0)
             return recs
 
-        def _commit(start, recs):
+        def _integrity_verify(s0, c0, host):
+            """Lattice check + sampled duplicate-execution audit over
+            one fetched chunk's field buffers (pre-encode — the window
+            a host flip would otherwise reach the shards through);
+            returns the (possibly healed) field tuple and the trusted
+            device digest."""
+            from ..runtime.integrity import (device_fields_digest_rows,
+                                             fields_digest_rows_host)
+
+            fields = tuple(host[:-1])
+            dig_dev = np.asarray(host[-1], np.uint32)
+            fields = (checker.corrupt_host(fields[0], ident=s0),) \
+                + fields[1:]
+            host_dig = fields_digest_rows_host(fields)
+            bad = checker.check_rows(dig_dev[:c0], host_dig[:c0],
+                                     ident=s0, producer="dataset")
+            audit = checker.audit_chunk(s0)
+            if not bad and not audit:
+                return fields, dig_dev
+
+            def _reexec(use_audit):
+                dev = sampler.dispatch(s0, width, audit=use_audit)
+                return dev, device_fields_digest_rows(dev)
+
+            out_a = None
+            if not bad:
+                out_a = _reexec(True)
+                dig_a = np.asarray(out_a[1], np.uint32)
+                mism = [int(j) for j in
+                        np.nonzero(dig_a[:c0] != dig_dev[:c0])[0]]
+                checker.note_audit(mism)
+                if not mism:
+                    return fields, dig_dev
+
+            evidence = {"producer": "dataset", "start": int(s0),
+                        "lattice_rows": [int(j) for j in bad]}
+
+            def reexecute():
+                a = out_a if out_a is not None else _reexec(True)
+                b = _reexec(False)
+                fetched = tuple(jax.device_get(a[0]))
+                return (fetched, np.asarray(a[1], np.uint32),
+                        np.asarray(b[1], np.uint32))
+
+            def verify(res):
+                fetched, dig_a, dig_b = res
+                return (np.array_equal(dig_a, dig_b) and np.array_equal(
+                    fields_digest_rows_host(fetched), dig_a))
+
+            fetched, dig_a, _ = checker.heal_verified(
+                reexecute, verify, producer="dataset", ident=s0,
+                evidence=evidence)
+            sdc_rows = [int(j) for j in
+                        np.nonzero(dig_a[:c0] != dig_dev[:c0])[0]]
+            if sdc_rows and bad:
+                checker.note_audit(sdc_rows)
+            rec = {"e": "integrity",
+                   "kind": "audit" if sdc_rows else "checksum",
+                   "start": int(s0), "healed": True,
+                   "rows": sdc_rows or [int(j) for j in bad]}
+            journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
+            journal_f.flush()
+            os.fsync(journal_f.fileno())
+            return fetched, dig_a
+
+        def _commit(start, recs, dig=None):
             """Durable record of one fresh chunk: record bytes land
             positionally in their shards (pwrite), the touched shards
             fsync, THEN the journal line, THEN the atomic cursor — a
@@ -264,6 +357,11 @@ class DatasetFactory:
             writer.fsync(touched)
             rec = {"e": "chunk", "start": int(start),
                    "count": len(recs), "sha": h.hexdigest()}
+            if dig is not None:
+                # the device-attested claim riding the durable record
+                # (checked equal to the host bytes before this commit)
+                rec["dig"] = int(np.bitwise_xor.reduce(
+                    np.asarray(dig, np.uint32)[:len(recs)]))
             journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
             journal_f.flush()
             os.fsync(journal_f.fileno())
@@ -276,6 +374,17 @@ class DatasetFactory:
                           nbytes=len(recs) * writer.stride)
             telemetry.count("records", len(recs))
             if faults is not None:
+                from ..runtime.integrity import maybe_bitrot
+                from .writer import shard_of, shard_path, slot_of
+
+                # disk.bitrot: decay record `start`'s freshly committed
+                # slot (tests) — found by scrub_dataset_dir / the
+                # sha-verifying resume, which recomputes the chunk
+                maybe_bitrot(
+                    faults,
+                    shard_path(out_dir, shard_of(start, self.n_shards)),
+                    token=f"start={start}",
+                    offset=slot_of(start, self.n_shards) * writer.stride)
                 cfg = faults.config("dataset.kill")
                 if cfg is not None:
                     after = cfg.get("after_start")
@@ -292,7 +401,10 @@ class DatasetFactory:
                 nonlocal stopped
                 s0, c0, dev = inflight.pop(0)
                 host = _fetch(dev)
-                _commit(s0, _encode(s0, c0, host))
+                dig = None
+                if checker is not None:
+                    host, dig = _integrity_verify(s0, c0, host)
+                _commit(s0, _encode(s0, c0, host), dig=dig)
                 _report(c0)
                 if (_stop_after_chunks is not None
                         and commits >= _stop_after_chunks):
@@ -320,7 +432,7 @@ class DatasetFactory:
             journal_f.close()
             writer.close()
 
-        return {
+        out = {
             "fingerprint": self.fingerprint,
             "n_records": self.n_records,
             "shards": self.n_shards,
@@ -329,6 +441,22 @@ class DatasetFactory:
             "resumed_chunks": resumed,
             "telemetry": telemetry.snapshot(),
         }
+        if checker is not None:
+            # the corpus run's integrity verdict, in the summary AND
+            # the durable manifest
+            out["integrity"] = checker.stats()
+            from ..io.export import _atomic_write_json
+
+            man_path = os.path.join(out_dir, _MANIFEST_NAME)
+            try:
+                with open(man_path) as f:
+                    man = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                man = None
+            if man is not None:
+                man["integrity"] = checker.stats()
+                _atomic_write_json(man_path, man, indent=1)
+        return out
 
     def reader(self, out_dir):
         """A :class:`~psrsigsim_tpu.datasets.writer.DatasetReader` over a

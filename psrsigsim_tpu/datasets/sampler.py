@@ -202,11 +202,14 @@ class RecordSampler:
                 specs.append(P(OBS_AXIS, None))
         return tuple(specs)
 
-    def program(self, width):
+    def program(self, width, audit=False):
         """One jitted sharded program per chunk width, resolved through
         the shared registry (the per-instance dict stays as the
-        lock-free fast path)."""
-        prog = self._programs.get(width)
+        lock-free fast path).  ``audit=True`` resolves a FRESH compiled
+        instance of the identical program under its own registry family
+        — the integrity layer's duplicate-execution path (nothing
+        compiles unless an audit actually runs)."""
+        prog = self._programs.get((width, audit))
         if prog is not None:
             return prog
         mesh = self.mesh
@@ -234,10 +237,11 @@ class RecordSampler:
         from ..runtime.programs import global_registry, trace_env_key
 
         prog = global_registry().get_or_build(
-            ("dataset_records", self._program_digest, mesh, int(width),
+            ("dataset_records_audit" if audit else "dataset_records",
+             self._program_digest, mesh, int(width),
              trace_env_key()),
             _build)
-        self._programs[width] = prog
+        self._programs[(width, audit)] = prog
         return prog
 
     def chunk_width(self, chunk_size):
@@ -249,15 +253,17 @@ class RecordSampler:
             raise ValueError("chunk_size must be positive")
         return chunk_size + (-chunk_size) % n_shards
 
-    def dispatch(self, start, width):
+    def dispatch(self, start, width, audit=False):
         """Launch one chunk asynchronously; returns device futures for
         records ``start..start+width`` (indices wrap modulo
-        ``n_records``; the caller trims the wrapped tail)."""
+        ``n_records``; the caller trims the wrapped tail).  ``audit``
+        dispatches through the fresh duplicate-execution instance
+        (:meth:`program`)."""
         idx = (start + np.arange(width)) % self.n_records
         root = jax.random.key(self.seed)
         idx_j = jnp.asarray(idx, jnp.int32)
         keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx_j)
-        return self.program(width)(
+        return self.program(width, audit=audit)(
             jax.device_put(keys, self._obs_sharding),
             jax.device_put(idx_j, self._obs_sharding),
             self._profiles_dev, self._freqs_dev, self._chan_ids_dev)

@@ -332,6 +332,16 @@ class DatasetReader:
         return self.read(shard_of(index, self.n_shards),
                          slot_of(index, self.n_shards))
 
+    def record_bytes(self, index):
+        """One record's RAW on-disk bytes by global index (no parsing) —
+        what the integrity scrub layer re-hashes against the journal's
+        per-chunk sha256 (:func:`psrsigsim_tpu.runtime.integrity.
+        scrub_dataset_dir`).  May be short when the record was never
+        committed."""
+        shard = shard_of(index, self.n_shards)
+        return os.pread(self._fd(shard), self.stride,
+                        slot_of(index, self.n_shards) * self.stride)
+
     def iter_epoch(self, epoch, shards=None):
         """Yield every record of the chosen shards (default: all) in
         the epoch's deterministic shuffled order, shard-major."""

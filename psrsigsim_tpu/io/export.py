@@ -1070,7 +1070,8 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                             ref_MJD=56000.0, writers=None,
                             obs_per_file=1, supervisor=None, faults=None,
                             pipeline_depth=2, telemetry=None,
-                            manifest_extra=None, scenario_params=None):
+                            manifest_extra=None, scenario_params=None,
+                            integrity=None):
     """Export ``n_obs`` ensemble observations as PSRFITS files.
 
     Args:
@@ -1147,6 +1148,18 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             Monte-Carlo study engine records which study generated a
             dataset here).  Keys never participate in resume matching
             and may not collide with fingerprint fields.
+        integrity: the silent-corruption defense
+            (:mod:`psrsigsim_tpu.runtime.integrity`): ``None`` consults
+            ``PSS_INTEGRITY`` (unset = off, the zero-cost default);
+            ``True`` / a float audit fraction / an
+            :class:`~psrsigsim_tpu.runtime.IntegrityChecker` arm the
+            per-chunk device-digest lattice, the deterministic
+            duplicate-execution audit (healed by verified
+            re-execution, byte-identical to a clean run), and the
+            ``integrity`` journal/manifest record.  Requires a
+            supervisor (the events need the durable journal).  Off, the
+            compiled programs and bytes are exactly the pre-integrity
+            ones.
 
     Returns:
         list of the output file paths (length ``ceil(n_obs/obs_per_file)``).
@@ -1183,6 +1196,20 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         obs_per_file, scenario=getattr(ens, "scenario", None),
         scenario_params=scenario_params)
     _check_manifest(out_dir, fp, resume)
+    from ..runtime.integrity import resolve_integrity
+
+    checker = resolve_integrity(
+        integrity,
+        fingerprint=hashlib.sha256(
+            json.dumps(fp, sort_keys=True).encode()).hexdigest(),
+        faults=faults)
+    if checker is not None and supervisor is None:
+        # integrity events are durable claims; without the supervisor's
+        # journal a detection would be a log line lost with the process
+        raise ValueError(
+            "integrity checking requires supervision: use "
+            "psrsigsim_tpu.runtime.supervised_export(..., integrity=...) "
+            "(or pass supervisor=)")
     if manifest_extra:
         clash = set(manifest_extra) & set(fp)
         if clash:
@@ -1328,8 +1355,14 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             finite_mask=supervisor is not None, rfi_mask=want_rfi,
             scenario_params=scenario_params,
             prefetch=max(1, pipeline_depth), fetch_ahead=pipeline_depth,
-            timers=telemetry,
+            timers=telemetry, integrity=checker,
         ):
+            dig_dev = None
+            if checker is not None:
+                # the device-attested per-observation digest rides the
+                # chunk as its last element (iter_chunks integrity=)
+                dig_dev = np.asarray(block[-1])
+                block = block[:-1]
             if supervisor is not None:
                 if want_rfi:
                     data, scl, offs, finite, rfi = block
@@ -1342,6 +1375,19 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
                     start, np.asarray(finite))
             else:
                 data, scl, offs = block
+            if checker is not None:
+                # checksum lattice + duplicate-execution audit: verify
+                # the fetched bytes against the device's claim (and, for
+                # sampled chunks, the device against a fresh execution
+                # of itself), healing any disagreement with verified
+                # re-executed bytes BEFORE anything reaches the writers.
+                # Must run before the '>i2' view below — the digest is
+                # defined over the native int16 values the device
+                # produced
+                data, scl, offs = _integrity_check_chunk(
+                    ens, checker, supervisor, start, chunk_size, n_obs,
+                    seed, dms, norms_main, scenario_params,
+                    data, scl, offs, dig_dev)
             # the device already emitted big-endian bit patterns
             # (ops.swap16): reinterpret, so every downstream record-array
             # refill and PSRFITS.save cast is a same-dtype memcpy
@@ -1426,22 +1472,125 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     # A fully-resumed no-op run records nothing: it must not replace the
     # real run's durable record with an all-zero snapshot
     snap = telemetry.snapshot()
-    if any(snap[f"{s}_calls"] for s in ("dispatch", "fetch", "encode",
-                                        "write")):
+    ran = any(snap[f"{s}_calls"] for s in ("dispatch", "fetch", "encode",
+                                           "write"))
+    if ran or checker is not None:
         man = _load_manifest(out_dir)
         if man is not None:
-            from ..runtime.programs import global_registry
+            if ran:
+                from ..runtime.programs import global_registry
 
-            man["pipeline"] = {"depth": pipeline_depth,
-                               "writers": int(writers),
-                               "chunk_size": int(chunk_size), **snap,
-                               # compile-count telemetry of the shared
-                               # program registry: how many programs
-                               # THIS process built (vs reused) to run
-                               # the export — the ROADMAP item 5 number
-                               "programs": global_registry().snapshot()}
+                man["pipeline"] = {"depth": pipeline_depth,
+                                   "writers": int(writers),
+                                   "chunk_size": int(chunk_size), **snap,
+                                   # compile-count telemetry of the
+                                   # shared program registry: how many
+                                   # programs THIS process built (vs
+                                   # reused) to run the export — the
+                                   # ROADMAP item 5 number
+                                   "programs": global_registry().snapshot()}
+            if checker is not None:
+                # the run's integrity verdict is part of the durable
+                # record: an operator reading the manifest sees whether
+                # the lattice/audit ever fired and whether this host's
+                # device is SDC-suspect
+                man["integrity"] = checker.stats()
             _write_manifest(out_dir, man)
     return paths
+
+
+def _integrity_check_chunk(ens, checker, supervisor, start, chunk_size,
+                           n_obs, seed, dms, noise_norms, scenario_params,
+                           data, scl, offs, dig_dev):
+    """One chunk through the integrity lattice + audit (the export
+    producer's wiring of :mod:`psrsigsim_tpu.runtime.integrity`).
+
+    Layer 1: recompute the per-observation digest from the FETCHED
+    triple and compare against the device's claim — a mismatch is
+    corruption in the fetch->encode window.  Layer 2: for the
+    deterministic ``audit_frac`` sample of chunks, re-dispatch the SAME
+    chunk (same width, same padded indices — bit-identical by the
+    chunk-invariance contract) through a fresh compiled instance and
+    compare claims.  Any disagreement heals through verified
+    re-execution: two independent executions must agree with each other
+    and with their own host re-digest; the agreed bytes replace the
+    chunk (byte-identical to a clean run — healing never re-draws), the
+    event lands in the run journal, and a disagreement that survives
+    re-execution raises :class:`~psrsigsim_tpu.runtime.IntegrityError`
+    (permanent — fail fast with the evidence).
+
+    Returns the (possibly healed) ``(data, scl, offs)``."""
+    from ..parallel.mesh import OBS_AXIS
+    from ..runtime.integrity import triple_digest_rows
+
+    count = data.shape[0]
+    dig_dev = np.asarray(dig_dev, np.uint32)[:count]
+    # host.corrupt arm (tests): flip a fetched value right where the
+    # exporter would encode it
+    data = checker.corrupt_host(data, ident=start)
+    host_dig = triple_digest_rows(data, scl, offs)
+    bad_rows = checker.check_rows(dig_dev, host_dig, ident=start,
+                                  producer="export")
+    audit = checker.audit_chunk(start)
+    if not bad_rows and not audit:
+        return data, scl, offs
+
+    # re-dispatch at the EXACT width and padded index content of the
+    # main pass — identical program key, identical rows, so digests are
+    # comparable bit for bit (ulp-safe: no batch-width change)
+    n_shards = ens.mesh.shape[OBS_AXIS]
+    eff = min(int(chunk_size), int(n_obs))
+    eff += (-eff) % n_shards
+    idx = (start + np.arange(eff)) % n_obs
+
+    def _reexec(audit_prog):
+        return ens.run_quantized_at(
+            idx, seed=seed, dms=dms, noise_norms=noise_norms,
+            byte_order="big", scenario_params=scenario_params,
+            audit=audit_prog, return_digest=True)
+
+    out_a = None
+    if not bad_rows:
+        # audit-only path: ONE duplicate execution; matching claims
+        # mean the device reproduced itself and the original bytes
+        # stand untouched
+        out_a = _reexec(True)
+        dig_a = np.asarray(out_a[-1], np.uint32)[:count]
+        mism = [int(j) for j in np.nonzero(dig_a != dig_dev)[0]]
+        checker.note_audit(mism)
+        if not mism:
+            return data, scl, offs
+
+    evidence = {"producer": "export", "start": int(start),
+                "lattice_rows": [int(j) for j in bad_rows],
+                "device_digests": [int(v) for v in dig_dev]}
+
+    def reexecute():
+        a = out_a if out_a is not None else _reexec(True)
+        b = _reexec(False)
+        return (np.asarray(a[0]), np.asarray(a[1]), np.asarray(a[2]),
+                np.asarray(a[-1], np.uint32), np.asarray(b[-1], np.uint32))
+
+    def verify(res):
+        da, sa, oa, dig_a, dig_b = res
+        # two independent executions must agree with each other AND
+        # with the host re-digest of the bytes we are about to adopt
+        return (np.array_equal(dig_a, dig_b)
+                and np.array_equal(triple_digest_rows(da, sa, oa), dig_a))
+
+    da, sa, oa, dig_a, _ = checker.heal_verified(
+        reexecute, verify, producer="export", ident=start,
+        evidence=evidence)
+    sdc_rows = [int(j) for j in np.nonzero(dig_a[:count] != dig_dev)[0]]
+    if sdc_rows and not bad_rows:
+        pass  # already counted by note_audit above
+    elif sdc_rows:
+        checker.note_audit(sdc_rows)
+    supervisor.record_integrity(
+        "audit" if sdc_rows else "checksum", start,
+        obs=[start + j for j in (sdc_rows or bad_rows)], healed=True,
+        detail={"lattice_rows": len(bad_rows), "sdc_rows": len(sdc_rows)})
+    return da[:count], sa[:count], oa[:count]
 
 
 def _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,

@@ -426,13 +426,17 @@ class MonteCarloStudy:
             setattr(ctx, name, getattr(self, name))
         return ctx
 
-    def _program(self, width):
+    def _program(self, width, audit=False):
         """One jitted sharded program per chunk width: trials -> metric
         rows (sharded vmap) + in-graph histogram/min/max reduction —
         resolved through the shared program registry keyed by the
         study's program digest (the per-instance dict stays as the
-        lock-free fast path)."""
-        prog = self._programs.get(width)
+        lock-free fast path).  ``audit=True`` resolves a FRESH compiled
+        instance of the identical program (its own registry family) —
+        the integrity layer's duplicate-execution path: same jaxpr,
+        independently compiled and executed, so digest agreement means
+        the device reproduced itself."""
+        prog = self._programs.get((width, audit))
         if prog is not None:
             return prog
         mesh = self.mesh
@@ -484,10 +488,11 @@ class MonteCarloStudy:
         from ..runtime.programs import global_registry, trace_env_key
 
         prog = global_registry().get_or_build(
-            ("mc_trial", self._program_digest, self.mesh, int(width),
+            ("mc_trial_audit" if audit else "mc_trial",
+             self._program_digest, self.mesh, int(width),
              trace_env_key()),
             _build)
-        self._programs[width] = prog
+        self._programs[(width, audit)] = prog
         return prog
 
     def _chunk_inputs(self, start, n_trials, width):
@@ -587,7 +592,7 @@ class MonteCarloStudy:
 
     def run(self, n_trials, chunk_size=256, out_dir=None, resume=True,
             telemetry=None, progress=None, faults=None, keep_trials=True,
-            _stop_after_chunks=None):
+            integrity=None, _stop_after_chunks=None):
         """Run (or resume) the sweep; returns a
         :class:`~psrsigsim_tpu.mc.StudyResult`.
 
@@ -609,7 +614,19 @@ class MonteCarloStudy:
             progress: optional callable ``progress(done, total)``.
             faults: optional
                 :class:`~psrsigsim_tpu.runtime.FaultPlan` (tests only;
-                arms the ``mc.kill`` point).
+                arms the ``mc.kill`` point — and, with ``integrity``,
+                ``device.sdc`` / ``host.corrupt`` / ``disk.bitrot``).
+            integrity: the silent-corruption defense
+                (:mod:`psrsigsim_tpu.runtime.integrity`): ``None``
+                consults ``PSS_INTEGRITY`` (unset = off); when armed,
+                each chunk's metric rows carry a device-computed digest
+                re-checked on host before the commit, a deterministic
+                ``audit_frac`` of chunks is duplicate-executed through
+                a fresh instance of the trial program, disagreements
+                heal by verified re-execution (bit-identical — healing
+                never re-draws), the journal's commit records carry the
+                device-attested ``dig`` claim, and the run stamps
+                ``integrity`` counters into the study manifest.
             keep_trials: write the per-trial metric matrix into the
                 artifact (tiny — a few floats per trial — and what
                 makes exact percentile/ECDF queries possible).
@@ -636,6 +653,15 @@ class MonteCarloStudy:
         chunk_size += (-chunk_size) % n_shards
         width = chunk_size
         prog = self._program(width)
+
+        from ..runtime.integrity import resolve_integrity
+
+        checker = resolve_integrity(
+            integrity,
+            fingerprint=hashlib.sha256(
+                json.dumps(self.fingerprint(n_trials),
+                           sort_keys=True).encode()).hexdigest(),
+            faults=faults)
 
         matrix = np.empty((n_trials, M), np.float32)
         hist_tot = np.zeros((M, self.hist_bins), np.int64)
@@ -700,7 +726,7 @@ class MonteCarloStudy:
             _merge(start, count, rows, hist, mn, mx)
             return True
 
-        def _commit(start, count, rows, hist, mn, mx):
+        def _commit(start, count, rows, hist, mn, mx, dig=None):
             """Durable record of one fresh chunk: rows land positionally
             in trials.f32 (pwrite + fsync), THEN the journal line, THEN
             the atomic cursor — a SIGKILL leaves either a committed
@@ -717,6 +743,13 @@ class MonteCarloStudy:
                    "hist": [int(v) for v in np.asarray(hist).reshape(-1)],
                    "mn": [float(v) for v in mn],
                    "mx": [float(v) for v in mx]}
+            if dig is not None:
+                # the device-attested claim: the journal line no longer
+                # records only what the HOST saw (sha over fetched
+                # bytes) but what the DEVICE computed — checked equal
+                # before this commit ran
+                rec["dig"] = int(np.bitwise_xor.reduce(
+                    np.asarray(dig, np.uint32)[:count]))
             journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
             journal_f.flush()
             os.fsync(journal_f.fileno())
@@ -727,6 +760,13 @@ class MonteCarloStudy:
                 "commits": commits, "journal_bytes": journal_f.tell()})
             telemetry.add("write", _time.perf_counter() - t0)
             if faults is not None:
+                from ..runtime.integrity import maybe_bitrot
+
+                # disk.bitrot: decay THIS chunk's freshly journaled rows
+                # (tests) — found by scrub_mc_dir / the sha-verifying
+                # resume, never served as good
+                maybe_bitrot(faults, raw_path, token=f"start={start}",
+                             offset=start * M * 4)
                 cfg = faults.config("mc.kill")
                 if cfg is not None:
                     after = cfg.get("after_start")
@@ -739,8 +779,83 @@ class MonteCarloStudy:
             keys, idxs = self._chunk_inputs(start, n_trials, width)
             out = prog(keys, idxs, jnp.int32(count), self._profiles_dev,
                        self._freqs_dev, self._chan_ids_dev)
+            if checker is not None:
+                from ..runtime.integrity import device_digest_rows
+
+                # device.sdc arm perturbs the metric rows BEFORE the
+                # digest attests them (the corruption the lattice
+                # cannot see); the digest rides the fetch as one extra
+                # tiny array
+                metrics = checker.apply_sdc(out[0], ident=start)
+                out = (metrics,) + tuple(out[1:]) \
+                    + (device_digest_rows(metrics),)
             telemetry.add("dispatch", _time.perf_counter() - t0)
             return out
+
+        def _integrity_verify(s0, c0, host):
+            """Lattice check + sampled duplicate-execution audit for one
+            fetched chunk; returns the (possibly healed) host tuple
+            ``(metrics, hist, mn, mx)`` and the trusted device digest."""
+            from ..runtime.integrity import device_digest_rows, digest_rows
+
+            metrics, hist, mn, mx, dig_dev = host
+            dig_dev = np.asarray(dig_dev, np.uint32)
+            metrics = checker.corrupt_host(metrics, ident=s0)
+            host_dig = digest_rows(np.ascontiguousarray(metrics))
+            bad = checker.check_rows(dig_dev[:c0], host_dig[:c0], ident=s0,
+                                     producer="mc")
+            audit = checker.audit_chunk(s0)
+            if not bad and not audit:
+                return (metrics, hist, mn, mx), dig_dev
+
+            def _reexec(use_audit):
+                p = self._program(width, audit=use_audit)
+                keys, idxs = self._chunk_inputs(s0, n_trials, width)
+                out = p(keys, idxs, jnp.int32(c0), self._profiles_dev,
+                        self._freqs_dev, self._chan_ids_dev)
+                return out, device_digest_rows(out[0])
+
+            out_a = None
+            if not bad:
+                out_a = _reexec(True)
+                dig_a = np.asarray(out_a[1], np.uint32)
+                mism = [int(j) for j in
+                        np.nonzero(dig_a[:c0] != dig_dev[:c0])[0]]
+                checker.note_audit(mism)
+                if not mism:
+                    return (metrics, hist, mn, mx), dig_dev
+
+            evidence = {"producer": "mc", "start": int(s0),
+                        "lattice_rows": [int(j) for j in bad]}
+
+            def reexecute():
+                a = out_a if out_a is not None else _reexec(True)
+                b = _reexec(False)
+                fetched = jax.device_get(a[0])
+                return (fetched, np.asarray(a[1], np.uint32),
+                        np.asarray(b[1], np.uint32))
+
+            def verify(res):
+                fetched, dig_a, dig_b = res
+                return (np.array_equal(dig_a, dig_b) and np.array_equal(
+                    digest_rows(np.ascontiguousarray(fetched[0])), dig_a))
+
+            fetched, dig_a, _ = checker.heal_verified(
+                reexecute, verify, producer="mc", ident=s0,
+                evidence=evidence)
+            sdc_rows = [int(j) for j in
+                        np.nonzero(dig_a[:c0] != dig_dev[:c0])[0]]
+            if sdc_rows and bad:
+                checker.note_audit(sdc_rows)
+            if journal_f is not None:
+                rec = {"e": "integrity",
+                       "kind": "audit" if sdc_rows else "checksum",
+                       "start": int(s0), "healed": True,
+                       "rows": sdc_rows or [int(j) for j in bad]}
+                journal_f.write(json.dumps(rec, sort_keys=True) + "\n")
+                journal_f.flush()
+                os.fsync(journal_f.fileno())
+            return tuple(fetched), dig_a
 
         def _fetch(dev):
             t0 = _time.perf_counter()
@@ -758,10 +873,16 @@ class MonteCarloStudy:
             def _drain_one():
                 nonlocal stopped
                 s0, c0, dev = inflight.pop(0)
-                metrics, hist, mn, mx = _fetch(dev)
+                host = _fetch(dev)
+                dig = None
+                if checker is not None:
+                    (metrics, hist, mn, mx), dig = _integrity_verify(
+                        s0, c0, host)
+                else:
+                    metrics, hist, mn, mx = host
                 rows = np.ascontiguousarray(metrics[:c0])
                 _merge(s0, c0, rows, hist, mn, mx)
-                _commit(s0, c0, rows, hist, mn, mx)
+                _commit(s0, c0, rows, hist, mn, mx, dig=dig)
                 _report(c0)
                 if (_stop_after_chunks is not None
                         and commits >= _stop_after_chunks):
@@ -787,6 +908,20 @@ class MonteCarloStudy:
                 journal_f.close()
             if raw_fd is not None:
                 os.close(raw_fd)
+
+        if checker is not None and out_dir is not None:
+            # the sweep's integrity verdict joins the durable record
+            from ..io.export import _atomic_write_json
+
+            man_path = os.path.join(out_dir, _MANIFEST_NAME)
+            try:
+                with open(man_path) as f:
+                    man = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                man = None
+            if man is not None:
+                man["integrity"] = checker.stats()
+                _atomic_write_json(man_path, man, indent=1)
 
         result = StudyResult(
             metric_names=self.metric_names,

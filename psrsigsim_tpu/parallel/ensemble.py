@@ -327,6 +327,16 @@ class FoldEnsemble:
             ("ensemble_quantized_packed", "big") + _gkey,
             lambda: jax.jit(
                 shard_map(_local_quantized_packed_be, **_packed_specs)))
+        # duplicate-execution audit support (runtime/integrity.py): the
+        # build closures + geometry key are kept so a FRESH compiled
+        # instance of the same packed program (same jaxpr -> same HLO ->
+        # same bytes) can be registered lazily — nothing compiles unless
+        # an integrity audit actually runs
+        self._gkey = _gkey
+        self._packed_locals = {"little": _local_quantized_packed,
+                               "big": _local_quantized_packed_be}
+        self._packed_specs = _packed_specs
+        self._audit_programs = {}
 
         if has_rfi:
             # mask-only program for the FLOAT32 streaming path
@@ -548,9 +558,27 @@ class FoldEnsemble:
                 jax.device_put(dms, obs_sharding),
                 jax.device_put(norms, obs_sharding))
 
+    def _audit_quantized_packed(self, byte_order):
+        """A FRESH jitted instance of the packed-quantized program (the
+        integrity layer's duplicate-execution path): identical jaxpr,
+        independently compiled — so agreement means the device computed
+        the same bytes twice, and disagreement is silent corruption.
+        Lazily registered under its own registry family; a run that
+        never audits never compiles it."""
+        prog = self._audit_programs.get(byte_order)
+        if prog is None:
+            fn = self._packed_locals[byte_order]
+            specs = self._packed_specs
+            prog = global_registry().get_or_build(
+                ("ensemble_quantized_packed_audit", byte_order) + self._gkey,
+                lambda: jax.jit(shard_map(fn, **specs)))
+            self._audit_programs[byte_order] = prog
+        return prog
+
     def run_quantized_at(self, indices, seed=0, dms=None, noise_norms=None,
                          byte_order="little", fold_salt=None,
-                         scenario_params=None, return_rfi=False):
+                         scenario_params=None, return_rfi=False,
+                         audit=False, return_digest=False):
         """Quantize exactly the observations ``indices`` (global ids) in
         one dispatch — the run supervisor's quarantine/retry primitive.
 
@@ -569,6 +597,17 @@ class FoldEnsemble:
         run's realization — under ``fold_salt`` that is the fresh fold's
         truth, which is what the supervisor's healed-observation record
         must follow.
+
+        ``audit=True`` dispatches through the integrity layer's FRESH
+        compiled instance of the same program
+        (:meth:`_audit_quantized_packed`) — bit-identical by
+        construction, independently executed, which is what makes a
+        digest disagreement evidence of silent device corruption.
+        ``return_digest=True`` appends the per-observation device
+        digest of the packed buffer (uint32, computed on device before
+        any byte crosses the link;
+        :func:`~psrsigsim_tpu.runtime.integrity.
+        device_packed_digest_rows`).
         """
         if byte_order not in ("little", "big"):
             raise ValueError("byte_order must be 'little' or 'big'")
@@ -602,21 +641,30 @@ class FoldEnsemble:
         keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms, noise_norms,
                                                 fold_salt=fold_salt)
         scp = self._prep_scenario(idx, scenario_params)
-        prog = (self._run_sharded_quantized_packed_be if byte_order == "big"
-                else self._run_sharded_quantized_packed)
+        if audit:
+            prog = self._audit_quantized_packed(byte_order)
+        else:
+            prog = (self._run_sharded_quantized_packed_be
+                    if byte_order == "big"
+                    else self._run_sharded_quantized_packed)
         out = prog(*self._program_args(keys, dms_c, norms_c, scp))
         data, scl, offs = self._split_packed_device(out[0])
         finite = out[1]
         result = (data[:n], scl[:n], offs[:n], finite[:n])
         if return_rfi:
             result = result + (out[-1][:n],)
+        if return_digest:
+            from ..runtime.integrity import device_packed_digest_rows
+
+            result = result + (
+                device_packed_digest_rows(out[0], self.cfg.nph)[:n],)
         return result
 
     def iter_chunks(self, n_obs, chunk_size=256, seed=0, dms=None,
                     noise_norms=None, quantized=False, progress=None,
                     skip_chunk=None, prefetch=1, byte_order="little",
                     finite_mask=False, fetch_ahead=0, timers=None,
-                    rfi_mask=False, scenario_params=None):
+                    rfi_mask=False, scenario_params=None, integrity=None):
         """Stream a large ensemble in fixed-size chunks.
 
         Yields ``(start, block)`` with ``block`` a host-materialized
@@ -692,6 +740,19 @@ class FoldEnsemble:
         ``dispatch``/``fetch`` stage times, fetched bytes, and fetch-queue
         depth samples accumulate there (the exporter adds encode/write).
 
+        ``integrity`` (quantized only): an armed
+        :class:`~psrsigsim_tpu.runtime.IntegrityChecker` — each chunk's
+        yielded tuple grows a LAST element, the per-observation uint32
+        device digest of the packed buffer, computed ON DEVICE before
+        the fetch (:func:`~psrsigsim_tpu.runtime.integrity.
+        device_packed_digest_rows`) so the consumer can re-check the
+        fetched bytes against a device-attested claim.  The checker's
+        ``device.sdc`` fault arm perturbs the device buffer here,
+        BEFORE the digest — modeling corruption the lattice cannot see
+        and only the duplicate-execution audit catches.  ``None`` (the
+        default) changes nothing: no digest program exists and the
+        compiled chunk programs are exactly the pre-integrity ones.
+
         Quantized chunks use fused transport internally: the device packs
         data+scl+offs into one contiguous buffer per chunk (one transfer
         instead of three; see ``_pack_triple``), and the host splits it
@@ -704,6 +765,9 @@ class FoldEnsemble:
             raise ValueError("byte_order must be 'little' or 'big'")
         if finite_mask and not quantized:
             raise ValueError("finite_mask requires quantized=True")
+        if integrity is not None and not quantized:
+            raise ValueError("integrity requires quantized=True (the "
+                             "checksum lattice rides the packed transport)")
         if rfi_mask and not self._has_rfi:
             raise ValueError(
                 "rfi_mask requires an ensemble built with an RFI "
@@ -736,11 +800,24 @@ class FoldEnsemble:
                         if byte_order == "big"
                         else self._run_sharded_quantized_packed)
                 outs = prog(*self._program_args(keys, dms_c, norms_c, scp))
-                dev = (outs[0][:count],)
+                packed = outs[0]
+                if integrity is not None:
+                    # device.sdc arm: perturb the device buffer BEFORE
+                    # the digest attests it (tests only; a None plan is
+                    # a no-op) — silent device corruption by definition
+                    # carries a self-consistent digest
+                    packed = integrity.apply_sdc(packed, ident=start)
+                dev = (packed[:count],)
                 if finite_mask:
                     dev = dev + (outs[1][:count],)
                 if rfi_mask:
                     dev = dev + (outs[-1][:count],)
+                if integrity is not None:
+                    from ..runtime.integrity import \
+                        device_packed_digest_rows
+
+                    dev = dev + (device_packed_digest_rows(
+                        packed[:count], nbin),)
             else:
                 args = self._program_args(keys, dms_c, norms_c, scp)
                 out = self._run_sharded(*args)

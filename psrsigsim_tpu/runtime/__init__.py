@@ -16,6 +16,12 @@
 - :mod:`~psrsigsim_tpu.runtime.telemetry` — per-stage timers for the
   streaming export pipeline (dispatch/fetch/encode/write, queue depths,
   bytes), accumulated into the export manifest and the bench report.
+- :mod:`~psrsigsim_tpu.runtime.integrity` — the silent-corruption
+  defense: in-graph checksum lattice (device-attested chunk digests),
+  deterministic duplicate-execution SDC audits, and the self-healing
+  scrub over every durable tier, with the ``device.sdc`` /
+  ``host.corrupt`` / ``disk.bitrot`` fault points proving detection
+  end to end.
 - :mod:`~psrsigsim_tpu.runtime.programs` — the shared program registry:
   one geometry-keyed compiled-artifact store (build counts, compile
   telemetry, persistent-compilation-cache wiring) that the ensemble,
@@ -24,15 +30,27 @@
 """
 
 from .faults import FaultPlan
+from .integrity import (IntegrityChecker, IntegrityError,
+                        resolve_integrity, scrub_dataset_dir,
+                        scrub_export_dir, scrub_mc_dir)
 from .programs import ProgramRegistry, enable_compilation_cache, \
     global_registry
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
 from .supervisor import (ProcessSupervisor, RunResult, RunSupervisor,
+                         load_chunk_journal, load_journal_records,
                          supervised_export)
 from .telemetry import StageTimers
 
 __all__ = [
     "FaultPlan",
+    "IntegrityChecker",
+    "IntegrityError",
+    "resolve_integrity",
+    "scrub_export_dir",
+    "scrub_mc_dir",
+    "scrub_dataset_dir",
+    "load_chunk_journal",
+    "load_journal_records",
     "ProgramRegistry",
     "RetryPolicy",
     "RetriesExhausted",

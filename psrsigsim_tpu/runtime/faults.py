@@ -106,6 +106,33 @@ point                     where it fires
                           ``times`` / ``match`` (token is the replica
                           id, so one plan can slow exactly one fleet
                           member).
+``device.sdc``            every integrity-armed producer (export
+                          ``iter_chunks``, MC trial chunks, dataset
+                          record chunks, serve batches) — ONE element
+                          of the chunk's device output buffer is
+                          perturbed before any digest is computed, so
+                          the checksum lattice attests the WRONG bytes
+                          (that is what silent device corruption looks
+                          like) and only the duplicate-execution audit
+                          can catch it.  Config: ``{"after_start":
+                          int}`` (chunk start; serve uses ``match`` on
+                          the spec hash) plus ``times``.
+``host.corrupt``          the same producers, host side — one element
+                          of a FETCHED buffer is flipped in place
+                          before the consumer encodes it (the
+                          fetch->encode window), which the in-graph
+                          checksum lattice's host re-check must catch.
+                          Config: ``{"after_start": int}`` / ``match``
+                          / ``times``.
+``disk.bitrot``           immediately AFTER a durable commit (export
+                          chunk files, MC ``trials.f32``, dataset
+                          shards, cache artifacts) — one byte of the
+                          committed file is XOR-flipped, after its
+                          sha256 became the journal's record: the decay
+                          the self-healing scrub layer
+                          (:mod:`psrsigsim_tpu.runtime.integrity`)
+                          exists to find.  Config: ``match`` (file
+                          basename / spec hash) / ``times``.
 ``cache.enospc``          :meth:`psrsigsim_tpu.serve.ResultCache.put`
                           — raises ``OSError(ENOSPC)`` mid-commit, the
                           disk-full case for the shared cache tier.
@@ -147,7 +174,8 @@ __all__ = ["FaultPlan", "should_fire", "crash_process", "POINTS"]
 POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
           "run.kill", "mc.kill", "dataset.kill", "serve.kill",
           "serve.reject", "replica.kill", "cache.contend",
-          "route.blackhole", "replica.slow", "cache.enospc")
+          "route.blackhole", "replica.slow", "cache.enospc",
+          "device.sdc", "host.corrupt", "disk.bitrot")
 
 
 class FaultPlan:

@@ -1,0 +1,727 @@
+"""End-to-end integrity: the silent-corruption defense of every durable path.
+
+Every sha256 this repo journals (export files, MC trial chunks, dataset
+record chunks, cache artifacts) is computed on the HOST, *after* the
+bytes left the device — so a bit flipped by device compute (SDC: silent
+data corruption, a real failure mode on large accelerator fleets), in
+host memory between fetch and encode, or by disk bit-rot after commit is
+journaled as "good" and served forever.  This module closes those
+windows with three layers:
+
+1. **Checksum lattice** — a cheap exact uint32 digest (positional
+   multiply-xor-sum fold over the quantized int16 codes or the bitcast
+   float words) computed ON DEVICE over each fused chunk's output buffer
+   before it crosses the host link, then recomputed on the host from the
+   fetched bytes at the point the producer consumes them.  The device
+   and host folds are bit-identical modular uint32 arithmetic, so any
+   disagreement is corruption in the fetch->consume window — the journal
+   record becomes a device-attested claim instead of a host-attested
+   one.  (The remaining consume->disk window is covered by the existing
+   in-memory sha256 of the committed bytes; see docs/robustness.md.)
+2. **Duplicate-execution audit** — a deterministic, fingerprint-seeded
+   ``audit_frac`` of chunks (default 2%, ``PSS_INTEGRITY_AUDIT_FRAC``)
+   is re-dispatched at full chunk width through a FRESH compiled
+   instance of the same-physics program (same jaxpr -> same HLO, so the
+   bytes must agree) and compared digest-for-digest.  A disagreement is
+   the SDC case the lattice cannot see (the digest of wrong bytes
+   matches the wrong bytes): the heal contract
+   (:meth:`IntegrityChecker.heal_verified`) then requires two
+   independent re-executions to agree with each other AND with the
+   host re-digest of the bytes being adopted — agreed bytes replace
+   the chunk (byte-identical to a clean run — healing never re-draws),
+   the event is journaled, and the sticky ``sdc_suspect`` health flag
+   the fleet's breaker/eject path can act on is set.  A disagreement
+   that SURVIVES re-execution is permanent (:class:`IntegrityError`,
+   never retried — see
+   :class:`~psrsigsim_tpu.runtime.retry.RetryPolicy` classification).
+3. **Self-healing scrub** — incremental re-hash of committed artifacts
+   against their journaled sha256: the serving cache drops-and-journals
+   corrupt artifacts (recommitted on the next request), export dirs
+   quarantine corrupt files aside so the next resume re-runs them, and
+   MC/dataset dirs surface corrupt chunks that the existing
+   sha-verifying resume paths recompute.  Bit-rot is found before a
+   reader is.
+
+Injection points (armed only by an explicit
+:class:`~psrsigsim_tpu.runtime.faults.FaultPlan`): ``device.sdc``
+perturbs one chunk's device output (only the audit can catch it),
+``host.corrupt`` flips a fetched buffer pre-encode (the lattice catches
+it), ``disk.bitrot`` flips a committed artifact's bytes (the scrub
+catches it).  tests/test_faults.py drives the full matrix across every
+producer.
+
+Everything here is OFF by default: with ``integrity=None`` and
+``PSS_INTEGRITY`` unset, no digest program is ever built and every
+producer takes exactly its pre-existing code path (compiled programs
+are jaxpr-identical to a build without this module).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+import numpy as np
+
+from .retry import RetryPolicy, call_with_retry
+
+__all__ = [
+    "IntegrityChecker", "IntegrityError", "resolve_integrity",
+    "digest_rows", "digest_array", "device_digest_rows",
+    "device_packed_digest_rows", "triple_digest_rows",
+    "audit_selected", "DEFAULT_AUDIT_FRAC",
+    "maybe_sdc", "maybe_host_corrupt", "maybe_bitrot",
+    "DirScrubber", "scrub_export_dir", "scrub_mc_dir", "scrub_dataset_dir",
+]
+
+#: default duplicate-execution audit fraction once integrity is enabled
+#: (``PSS_INTEGRITY_AUDIT_FRAC`` overrides; 0 disables auditing while
+#: keeping the checksum lattice)
+DEFAULT_AUDIT_FRAC = 0.02
+
+# digest constants (Knuth/Murmur-style odd multipliers); the fold is
+#   sum_i ((w_i ^ m_i) * GOLD + m_i)  mod 2^32,  m_i = (i+salt)*GOLD + OFF
+# — positional (catches swapped words), exact (pure modular integer
+# arithmetic, so host numpy and device XLA agree bit for bit), and one
+# multiply-add per word (cheap next to the pipeline it guards)
+_GOLD = 0x9E3779B1
+_OFF = 0x85EBCA77
+_MASK = 0xFFFFFFFF
+
+# component salts of a (data, scl, offs) quantized triple digest — the
+# three streams fold with disjoint positional multipliers so a value
+# migrating between components cannot cancel
+_SALT_DATA, _SALT_SCL, _SALT_OFFS = 0, 1 << 20, 2 << 20
+
+
+class IntegrityError(RuntimeError):
+    """A corruption that survived its one verified re-execution.
+
+    PERMANENT by classification: re-running cannot help (two independent
+    executions already disagree with each other and with the original),
+    so retry loops must fail fast instead of burning their backoff
+    budget — :func:`~psrsigsim_tpu.runtime.retry.call_with_retry`
+    re-raises it immediately when the policy classifies it permanent.
+    :attr:`evidence` carries the audit trail (producer, chunk start,
+    the disagreeing digests) for the operator."""
+
+    def __init__(self, message, evidence=None):
+        self.evidence = dict(evidence or {})
+        if self.evidence:
+            message = f"{message} [evidence: {self.evidence}]"
+        super().__init__(message)
+
+
+# ---------------------------------------------------------------------------
+# the digest fold — host (numpy) and device (jnp) twins
+# ---------------------------------------------------------------------------
+
+
+def _host_words_u32(arr):
+    """Elementwise uint32 words of a host array: float32 bitcast, 64-bit
+    dtypes reinterpreted as word pairs, integers value-converted with
+    C wrap semantics — each exactly what the device twin computes."""
+    a = np.asarray(arr)
+    if a.dtype == np.float32:
+        return np.ascontiguousarray(a).view(np.uint32)
+    if a.dtype.itemsize == 8:
+        return np.ascontiguousarray(a).view(np.uint32)
+    if a.dtype.kind in "iub":
+        return a.astype(np.uint32)
+    raise TypeError(f"undigestable dtype {a.dtype}")
+
+
+def _fold_u32(words, salt):
+    """The modular fold over a (rows, n) uint32 word matrix -> (rows,)
+    uint32.  Host arithmetic runs in uint64 and masks, which equals the
+    device's wrapping uint32 arithmetic exactly."""
+    w = words.astype(np.uint64)
+    n = w.shape[-1]
+    m = ((np.arange(n, dtype=np.uint64) + np.uint64(salt & _MASK))
+         * np.uint64(_GOLD) + np.uint64(_OFF)) & np.uint64(_MASK)
+    terms = (((w ^ m) * np.uint64(_GOLD)) + m) & np.uint64(_MASK)
+    return (terms.sum(axis=-1, dtype=np.uint64) & np.uint64(_MASK)).astype(
+        np.uint32)
+
+
+def digest_rows(arr, salt=0):
+    """Per-row host digest of ``arr`` (leading axis = rows): ``(B,)``
+    uint32, bit-identical to :func:`device_digest_rows` on the same
+    logical values."""
+    a = np.asarray(arr)
+    if a.ndim == 0:
+        raise ValueError("digest_rows needs at least one axis")
+    w = _host_words_u32(a).reshape(a.shape[0], -1)
+    return _fold_u32(w, salt)
+
+
+def digest_array(arr, salt=0):
+    """Whole-array host digest (one uint32 as a python int)."""
+    a = np.asarray(arr)
+    return int(digest_rows(a.reshape(1, -1), salt)[0])
+
+
+def triple_digest_rows(data, scl, offs):
+    """Per-observation host digest of a quantized ``(data, scl, offs)``
+    triple: the three component folds (disjoint salts) summed mod 2^32.
+    ``data`` must be NATIVE int16 (digest before any ``.view('>i2')`` —
+    a byte-order view changes values, and the device digested the
+    native values of the packed buffer)."""
+    d = digest_rows(data, _SALT_DATA)
+    s = digest_rows(np.ascontiguousarray(scl, np.float32), _SALT_SCL)
+    o = digest_rows(np.ascontiguousarray(offs, np.float32), _SALT_OFFS)
+    return ((d.astype(np.uint64) + s + o) & np.uint64(_MASK)).astype(
+        np.uint32)
+
+
+def _dev_fold_u32(words, salt):
+    """Device twin of :func:`_fold_u32` (traced; uint32 wraps mod 2^32
+    by construction)."""
+    import jax.numpy as jnp
+
+    n = words.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    m = (idx + jnp.uint32(salt & _MASK)) * jnp.uint32(_GOLD) \
+        + jnp.uint32(_OFF)
+    terms = ((words ^ m) * jnp.uint32(_GOLD)) + m
+    return jnp.sum(terms, axis=-1, dtype=jnp.uint32)
+
+
+def _dev_words_u32(x):
+    import jax
+    import jax.numpy as jnp
+
+    if x.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    if x.dtype.itemsize == 8:
+        # 64-bit elements bitcast to uint32 word pairs (a trailing axis
+        # of 2, little-endian word order) — exactly the host twin's
+        # ``view(np.uint32)`` reinterpretation, NOT a value truncation
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def _digest_rows_traced(x, salt=0):
+    """Traced per-row digest: the body every digest program jits."""
+    w = _dev_words_u32(x).reshape(x.shape[0], -1)
+    return _dev_fold_u32(w, salt)
+
+
+def _digest_program(kind, builder):
+    """Resolve a jitted digest program through the shared registry so
+    build counts stay visible; one program per kind, retracing per input
+    shape (chunk shapes are fixed per run, so one trace each)."""
+    import jax
+
+    from .programs import global_registry, trace_env_key
+
+    return global_registry().get_or_build(
+        ("integrity_digest", kind, trace_env_key()),
+        lambda: jax.jit(builder))
+
+
+def device_digest_rows(x, salt=0):
+    """Per-row digest of a DEVICE array, computed on device (one tiny
+    dispatch over the already-resident buffer — the attestation happens
+    before any byte crosses the host link).  Returns a device ``(B,)``
+    uint32 array; fetch it alongside the chunk."""
+    kind = "rows" if not salt else f"rows{salt}"  # distinct programs
+    return _digest_program(
+        kind, lambda a, _s=salt: _digest_rows_traced(a, _s))(x)
+
+
+def device_packed_digest_rows(packed, nbin):
+    """Per-observation device digest of a fused-transport packed chunk
+    ``(B, nsub, C, nbin+4)`` int16: the data slice and the bitcast
+    scl/offs tail words fold with the SAME salts as the host
+    :func:`triple_digest_rows` of the split triple — so the host
+    re-check needs only the split arrays every consumer already holds."""
+    import jax
+    import jax.numpy as jnp
+
+    def _fn(p):
+        data = p[..., :nbin]
+        scl_u = jax.lax.bitcast_convert_type(
+            p[..., nbin:nbin + 2], jnp.uint32)
+        offs_u = jax.lax.bitcast_convert_type(
+            p[..., nbin + 2:nbin + 4], jnp.uint32)
+        d = _digest_rows_traced(data, _SALT_DATA)
+        s = _dev_fold_u32(scl_u.reshape(p.shape[0], -1), _SALT_SCL)
+        o = _dev_fold_u32(offs_u.reshape(p.shape[0], -1), _SALT_OFFS)
+        return d + s + o
+
+    return _digest_program(f"packed{nbin}", _fn)(packed)
+
+
+def fields_digest_rows_host(arrays):
+    """Combined per-record host digest of a tuple of per-field arrays
+    (the dataset chunk layout): each field folds with its own salt,
+    summed mod 2^32."""
+    total = np.zeros(np.asarray(arrays[0]).shape[0], np.uint64)
+    for f, a in enumerate(arrays):
+        total = (total + digest_rows(a, salt=(f + 1) << 16)) \
+            & np.uint64(_MASK)
+    return total.astype(np.uint32)
+
+
+def device_fields_digest_rows(arrays):
+    """Device twin of :func:`fields_digest_rows_host` (one dispatch over
+    the chunk's field buffers)."""
+    def _fn(*devs):
+        total = None
+        for f, a in enumerate(devs):
+            d = _digest_rows_traced(a, salt=(f + 1) << 16)
+            total = d if total is None else total + d
+        return total
+
+    return _digest_program(f"fields{len(arrays)}", _fn)(*arrays)
+
+
+# ---------------------------------------------------------------------------
+# audit sampling
+# ---------------------------------------------------------------------------
+
+
+def audit_selected(fingerprint, ident, frac):
+    """Deterministic fingerprint-seeded chunk sampling: chunk ``ident``
+    of the run fingerprinted ``fingerprint`` is audited iff the leading
+    64 bits of ``sha256(fingerprint|ident)`` fall below ``frac`` — the
+    same chunks audit on every resume of the same run (so a kill/resume
+    cannot dodge its audits), different runs audit different chunks."""
+    frac = float(frac)
+    if frac <= 0.0:
+        return False
+    if frac >= 1.0:
+        return True
+    h = hashlib.sha256(f"{fingerprint}|{ident}".encode()).digest()
+    return int.from_bytes(h[:8], "big") < int(frac * 2.0 ** 64)
+
+
+# ---------------------------------------------------------------------------
+# fault helpers (device.sdc / host.corrupt / disk.bitrot)
+# ---------------------------------------------------------------------------
+
+
+def _ident_matches(cfg, ident):
+    after = cfg.get("after_start")
+    return after is None or (ident is not None and int(after) == int(ident))
+
+
+def maybe_sdc(plan, dev, token="", ident=None):
+    """``device.sdc`` injection: return the device buffer with ONE
+    element perturbed (+1 on the int16 code / +1.0 on the float word at
+    the origin) — the device "computed" wrong bytes, so every digest of
+    this buffer attests the wrong bytes and only duplicate execution
+    can notice.  Config: ``{"after_start": int}`` (chunk start) plus
+    the usual ``match``/``times``."""
+    if plan is None:
+        return dev
+    cfg = plan.config("device.sdc")
+    if cfg is None or not _ident_matches(cfg, ident):
+        return dev
+    if not plan.fire("device.sdc", token=token):
+        return dev
+    origin = (0,) * dev.ndim
+    bump = 1.0 if dev.dtype.kind == "f" else 1
+    return dev.at[origin].add(bump)
+
+
+def maybe_host_corrupt(plan, arr, token="", ident=None):
+    """``host.corrupt`` injection: flip one element of a FETCHED host
+    buffer (the fetch->encode window the checksum lattice closes).
+    Returns the buffer to use downstream — the same object when
+    unarmed, a corrupted copy when the point fired (fetched device
+    buffers are read-only views, exactly like the real corruption
+    victim: the corruption happens to the memory, not through the
+    array API)."""
+    if plan is None:
+        return arr
+    cfg = plan.config("host.corrupt")
+    if cfg is None or not _ident_matches(cfg, ident):
+        return arr
+    if not plan.fire("host.corrupt", token=token):
+        return arr
+    a = np.array(arr)   # writable copy standing in for the flipped page
+    origin = (0,) * a.ndim
+    if a.dtype.kind == "f":
+        # flip the mantissa LSB of the first word: unlike adding a
+        # constant, a bit flip changes the pattern for EVERY value
+        u = a.view(np.uint32 if a.dtype.itemsize == 4 else np.uint64)
+        u[(0,) * u.ndim] ^= 1
+    else:
+        a[origin] = a[origin] ^ 1
+    return a
+
+
+def maybe_bitrot(plan, path, token=None, offset=None):
+    """``disk.bitrot`` injection: XOR one byte of a COMMITTED file
+    (after its sha256 was journaled), the decay the scrub layer exists
+    to find.  Token defaults to the basename so ``match`` can target
+    one artifact; ``offset`` defaults to the middle of the file
+    (positional-slot formats pass the committed chunk's own offset so
+    the flip lands in journaled bytes).  Returns True when it fired."""
+    if plan is None:
+        return False
+    cfg = plan.config("disk.bitrot")
+    if cfg is None:
+        return False
+    if not plan.fire("disk.bitrot",
+                     token=os.path.basename(path) if token is None
+                     else token):
+        return False
+    size = os.path.getsize(path)
+    if size == 0:
+        return False
+    pos = size // 2 if offset is None else min(int(offset), size - 1)
+    with open(path, "rb+") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+        f.flush()
+        os.fsync(f.fileno())
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the checker: per-run integrity state
+# ---------------------------------------------------------------------------
+
+
+def _env_enabled():
+    return os.environ.get("PSS_INTEGRITY", "").lower() in ("1", "on",
+                                                           "true", "yes")
+
+
+def _env_audit_frac():
+    try:
+        return float(os.environ.get("PSS_INTEGRITY_AUDIT_FRAC",
+                                    DEFAULT_AUDIT_FRAC))
+    except ValueError:
+        return DEFAULT_AUDIT_FRAC
+
+
+class IntegrityChecker:
+    """One run's integrity configuration + counters.
+
+    Producers hold one checker per run (export, study sweep, corpus
+    write, serving engine) and report through it; its :meth:`stats`
+    land in manifests, ``/metrics`` and ``health()``.  Thread-safe
+    (the serving batcher and scrub heartbeat share one).
+
+    Parameters
+    ----------
+    audit_frac : float
+        Duplicate-execution audit fraction (0 disables the audit but
+        keeps the checksum lattice).  Default:
+        ``PSS_INTEGRITY_AUDIT_FRAC`` (2%).
+    fingerprint : str
+        Seed of the deterministic audit sampling — the run's own
+        fingerprint digest, so resumes audit the same chunks.
+    faults : FaultPlan, optional
+        Arms ``device.sdc`` / ``host.corrupt`` / ``disk.bitrot``.
+    """
+
+    def __init__(self, audit_frac=None, fingerprint="", faults=None):
+        self.audit_frac = (_env_audit_frac() if audit_frac is None
+                           else float(audit_frac))
+        if not 0.0 <= self.audit_frac <= 1.0:
+            raise ValueError("audit_frac must be in [0, 1]")
+        self.fingerprint = str(fingerprint)
+        self.faults = faults
+        self._lock = threading.Lock()
+        self.checks = 0               # host-vs-device checksum compares
+        self.checksum_mismatches = 0  # host.corrupt-window detections
+        self.audits = 0               # duplicate executions run
+        self.audit_mismatches = 0     # device-disagreement detections
+        self.healed_chunks = 0        # chunks replaced by verified bytes
+        self.permanent_failures = 0   # IntegrityError raised
+        self.sdc_suspect = False      # sticky: device disagreed with its
+        #                               own re-execution at least once
+
+    # -- sampling / fault arms --------------------------------------------
+
+    def audit_chunk(self, ident):
+        return audit_selected(self.fingerprint, ident, self.audit_frac)
+
+    def apply_sdc(self, dev, ident=None, token=None):
+        return maybe_sdc(self.faults, dev,
+                         token=f"start={ident}" if token is None else token,
+                         ident=ident)
+
+    def corrupt_host(self, arr, ident=None, token=None):
+        """Apply the ``host.corrupt`` arm; returns the buffer to use
+        downstream (a corrupted copy when the point fired)."""
+        return maybe_host_corrupt(
+            self.faults, arr,
+            token=f"start={ident}" if token is None else token, ident=ident)
+
+    # -- verdicts ----------------------------------------------------------
+
+    def check_rows(self, device_digests, host_digests, ident=None,
+                   producer=""):
+        """Compare fetched device digests against the host recompute;
+        returns the mismatching row indices (empty = the fetch->consume
+        window was clean)."""
+        dev = np.asarray(device_digests, np.uint32).reshape(-1)
+        host = np.asarray(host_digests, np.uint32).reshape(-1)
+        n = min(dev.size, host.size)
+        bad = np.nonzero(dev[:n] != host[:n])[0]
+        with self._lock:
+            self.checks += 1
+            if bad.size:
+                self.checksum_mismatches += 1
+        return [int(j) for j in bad]
+
+    def note_audit(self, mismatch_rows):
+        with self._lock:
+            self.audits += 1
+            if mismatch_rows:
+                self.audit_mismatches += 1
+                self.sdc_suspect = True
+
+    def note_healed(self):
+        with self._lock:
+            self.healed_chunks += 1
+
+    def fail_permanent(self, message, evidence=None):
+        with self._lock:
+            self.permanent_failures += 1
+            self.sdc_suspect = True
+        raise IntegrityError(message, evidence)
+
+    def heal_verified(self, reexecute, verify, *, producer, ident,
+                      evidence=None):
+        """Run ``reexecute()`` and require ``verify(result) -> True`` —
+        the heal contract every producer shares: a fresh execution whose
+        own device/host digests agree replaces the corrupt chunk; a
+        verification that fails even on re-execution is PERMANENT and
+        fails fast with the evidence attached (the retry-classification
+        contract: one transient re-execute is budgeted, an integrity
+        mismatch that survives it never burns backoff)."""
+        def _attempt():
+            out = reexecute()
+            if not verify(out):
+                self.fail_permanent(
+                    f"{producer}: re-executed chunk {ident} failed its own "
+                    "digest verification", evidence)
+            return out
+
+        out = call_with_retry(
+            _attempt,
+            RetryPolicy(max_attempts=2, base_delay=0.0,
+                        permanent_on=(IntegrityError,)))
+        self.note_healed()
+        return out
+
+    # -- reporting ---------------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {
+                "audit_frac": self.audit_frac,
+                "checks": self.checks,
+                "checksum_mismatches": self.checksum_mismatches,
+                "audits": self.audits,
+                "audit_mismatches": self.audit_mismatches,
+                "healed_chunks": self.healed_chunks,
+                "permanent_failures": self.permanent_failures,
+                "sdc_suspect": self.sdc_suspect,
+            }
+
+    def __repr__(self):
+        return (f"IntegrityChecker(audit_frac={self.audit_frac}, "
+                f"checks={self.checks}, audits={self.audits}, "
+                f"sdc_suspect={self.sdc_suspect})")
+
+
+def resolve_integrity(integrity, fingerprint="", faults=None):
+    """The one arming rule every producer shares.
+
+    ``integrity`` may be: None (consult ``PSS_INTEGRITY`` — unset means
+    OFF, the zero-cost default), False (force off), True (on with env/
+    default audit fraction), a float (on with that audit fraction), or
+    an :class:`IntegrityChecker` (used as-is; an unset fingerprint or
+    fault plan is stamped from the call site so the checker follows the
+    run it guards).  Returns a checker or None."""
+    if integrity is None:
+        if not _env_enabled():
+            return None
+        integrity = True
+    if integrity is False:
+        return None
+    if integrity is True:
+        return IntegrityChecker(fingerprint=fingerprint, faults=faults)
+    if isinstance(integrity, (int, float)) and not isinstance(
+            integrity, bool):
+        return IntegrityChecker(audit_frac=float(integrity),
+                                fingerprint=fingerprint, faults=faults)
+    if isinstance(integrity, IntegrityChecker):
+        if not integrity.fingerprint:
+            integrity.fingerprint = str(fingerprint)
+        if integrity.faults is None:
+            integrity.faults = faults
+        return integrity
+    raise TypeError(f"integrity must be None/bool/float/IntegrityChecker, "
+                    f"got {integrity!r}")
+
+
+# ---------------------------------------------------------------------------
+# the scrub layer
+# ---------------------------------------------------------------------------
+
+
+def _file_sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+class DirScrubber:
+    """Incremental scrubber over a ``{basename: sha256}`` record (an
+    export manifest's ``files`` map): :meth:`step` re-hashes a bounded
+    number of files per call — the per-heartbeat budget that keeps the
+    scrub off any latency path — rotating through the record forever.
+
+    A mismatched file is QUARANTINED (renamed ``<name>.quarantine``) so
+    even a plain existence-keyed resume re-runs it; a hash-verified
+    resume would also catch it, but quarantine means the very next
+    resume heals regardless of its verify mode."""
+
+    def __init__(self, out_dir, hashes, quarantine=True):
+        self.out_dir = str(out_dir)
+        self.hashes = dict(hashes)
+        self.quarantine = bool(quarantine)
+        self._ring = sorted(self.hashes)
+        self._pos = 0
+        self.scrubbed = 0      # files re-hashed clean
+        self.scrub_errors = 0  # mismatches found (and quarantined)
+        self.bad = []          # basenames that failed
+
+    def step(self, max_files=1):
+        """Re-hash up to ``max_files`` committed files; returns the list
+        of basenames found corrupt THIS step."""
+        found = []
+        for _ in range(int(max_files)):
+            if not self._ring:
+                return found
+            name = self._ring[self._pos % len(self._ring)]
+            self._pos += 1
+            path = os.path.join(self.out_dir, name)
+            try:
+                ok = _file_sha256(path) == self.hashes[name]
+            except OSError:
+                continue   # missing: resume already treats it as undone
+            if ok:
+                self.scrubbed += 1
+                continue
+            self.scrub_errors += 1
+            self.bad.append(name)
+            found.append(name)
+            if self.quarantine:
+                try:
+                    os.replace(path, path + ".quarantine")
+                except OSError:
+                    pass
+        return found
+
+    def run_all(self):
+        """One full pass over the record; returns the summary dict."""
+        self.step(max_files=len(self._ring))
+        return {"scanned": self.scrubbed + self.scrub_errors,
+                "scrubbed": self.scrubbed,
+                "scrub_errors": self.scrub_errors,
+                "bad": list(self.bad)}
+
+
+def scrub_export_dir(out_dir, quarantine=True):
+    """One full scrub pass over a supervised export's manifest record:
+    re-hash every committed file against its journaled sha256 and
+    quarantine mismatches aside (``*.quarantine``) so the next
+    ``supervised_export(..., resume=True)`` re-runs exactly those
+    observations — detection here, heal on resume, bytes identical to a
+    never-rotted run."""
+    from ..io.export import _load_manifest
+
+    man = _load_manifest(out_dir) or {}
+    return DirScrubber(out_dir, man.get("files", {}),
+                       quarantine=quarantine).run_all()
+
+
+def scrub_mc_dir(out_dir):
+    """Scrub a study sweep dir: re-hash every journaled trial chunk's
+    rows from ``trials.f32`` against the journal sha.  Returns the
+    summary with ``bad`` = corrupt chunk starts; healing is
+    ``study.run(resume=True)`` — its resume path re-verifies the same
+    hashes and recomputes exactly the failing chunks."""
+    from ..mc import study as _study
+    from .supervisor import load_chunk_journal
+
+    journal = os.path.join(out_dir, _study._JOURNAL_NAME)
+    raw = os.path.join(out_dir, _study._TRIALS_RAW)
+    done = load_chunk_journal(journal)
+    man_path = os.path.join(out_dir, _study._MANIFEST_NAME)
+    import json as _json
+
+    with open(man_path) as f:
+        man = _json.load(f)
+    n_metrics = len(man.get("metrics", ()))
+    bad, ok = [], 0
+    try:
+        fd = os.open(raw, os.O_RDONLY)
+    except FileNotFoundError:
+        return {"scanned": 0, "scrubbed": 0, "scrub_errors": 0, "bad": []}
+    try:
+        for start, rec in sorted(done.items()):
+            nbytes = int(rec["count"]) * n_metrics * 4
+            blob = os.pread(fd, nbytes, start * n_metrics * 4)
+            if (len(blob) == nbytes
+                    and hashlib.sha256(blob).hexdigest() == rec.get("sha")):
+                ok += 1
+            else:
+                bad.append(int(start))
+    finally:
+        os.close(fd)
+    return {"scanned": ok + len(bad), "scrubbed": ok,
+            "scrub_errors": len(bad), "bad": bad}
+
+
+def scrub_dataset_dir(out_dir):
+    """Scrub a dataset corpus dir: re-hash every journaled record
+    chunk's bytes out of the shards against the journal sha.  Returns
+    ``bad`` = corrupt chunk starts; healing is
+    ``DatasetFactory.run(resume=True)`` — the factory's resume already
+    re-hashes journaled chunks from shard bytes and recomputes any that
+    fail."""
+    import json as _json
+
+    from ..datasets import factory as _factory
+    from ..datasets.writer import DatasetReader
+    from .supervisor import load_chunk_journal
+
+    journal = os.path.join(out_dir, _factory._JOURNAL_NAME)
+    done = load_chunk_journal(journal)
+    with open(os.path.join(out_dir, _factory._MANIFEST_NAME)) as f:
+        man = _json.load(f)
+    del man   # manifest existence is the corpus check; bytes come below
+    with DatasetReader(out_dir) as reader:
+        stride = reader.stride
+        bad, ok = [], 0
+        for start, rec in sorted(done.items()):
+            h = hashlib.sha256()
+            complete = True
+            for i in range(start, start + int(rec["count"])):
+                buf = reader.record_bytes(i)
+                if len(buf) != stride:
+                    complete = False
+                    break
+                h.update(buf)
+            if complete and h.hexdigest() == rec.get("sha"):
+                ok += 1
+            else:
+                bad.append(int(start))
+    return {"scanned": ok + len(bad), "scrubbed": ok,
+            "scrub_errors": len(bad), "bad": bad}

@@ -42,6 +42,18 @@ class RetryPolicy:
     bounds the total number of attempts (first try included); the
     policy object is immutable and shareable across call sites.
 
+    ``permanent_on`` (a tuple of exception types, default empty)
+    classifies errors: an exception matching it is PERMANENT — retrying
+    cannot help — and :func:`call_with_retry` re-raises it immediately
+    instead of burning the backoff budget on it.  The canonical case is
+    :class:`~psrsigsim_tpu.runtime.integrity.IntegrityError`: a
+    corruption that survived its one verified re-execution already has
+    two independent executions disagreeing, so a retry loop treating it
+    like a flaky writer would just re-prove the disagreement slowly
+    while the audit evidence went stale.  Transient-vs-permanent is the
+    policy's call, not the loop's: every call site sharing a policy
+    shares one classification.
+
     ``jitter`` (0..1, default 0 = exactly the deterministic schedule)
     spreads each delay uniformly over the bounded band
     ``[d*(1-jitter), min(max_delay, d*(1+jitter))]`` around the
@@ -58,7 +70,7 @@ class RetryPolicy:
     """
 
     def __init__(self, max_attempts=3, base_delay=0.5, max_delay=30.0,
-                 multiplier=2.0, jitter=0.0, rng=None):
+                 multiplier=2.0, jitter=0.0, rng=None, permanent_on=()):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if base_delay < 0 or max_delay < 0:
@@ -72,6 +84,7 @@ class RetryPolicy:
         self.max_delay = float(max_delay)
         self.multiplier = float(multiplier)
         self.jitter = float(jitter)
+        self.permanent_on = tuple(permanent_on)
         if rng is None and self.jitter > 0.0:
             import random
 
@@ -87,6 +100,12 @@ class RetryPolicy:
         lo = d * (1.0 - self.jitter)
         hi = min(self.max_delay, d * (1.0 + self.jitter))
         return lo + self._rng() * (hi - lo)
+
+    def is_permanent(self, err):
+        """Error classification: True means retrying cannot help and the
+        caller must fail fast (with whatever evidence the error
+        carries) instead of spending the backoff budget."""
+        return isinstance(err, self.permanent_on)
 
     def delays(self):
         """The full schedule: one delay per retry (``max_attempts - 1``)."""
@@ -107,6 +126,11 @@ def call_with_retry(fn, policy=None, retry_on=(Exception,), on_retry=None,
     :class:`RetriesExhausted` (with the last error chained) once the
     attempt budget is spent.  ``sleep`` is injectable so tests run the
     schedule without wall-clock cost.
+
+    Errors the policy classifies PERMANENT (``policy.is_permanent``)
+    are re-raised immediately — no backoff, no further attempts: the
+    evidence they carry (an integrity mismatch's audit trail) reaches
+    the operator fresh instead of after a spent retry budget.
     """
     policy = policy or RetryPolicy()
     last = None
@@ -114,6 +138,8 @@ def call_with_retry(fn, policy=None, retry_on=(Exception,), on_retry=None,
         try:
             return fn()
         except retry_on as err:  # noqa: PERF203 — retry loop by design
+            if policy.is_permanent(err):
+                raise
             last = err
             if attempt == policy.max_attempts - 1:
                 break

@@ -50,7 +50,8 @@ from .faults import crash_process
 from .retry import RetryPolicy
 
 __all__ = ["RunSupervisor", "RunResult", "supervised_export",
-           "ProcessSupervisor", "load_chunk_journal"]
+           "ProcessSupervisor", "load_chunk_journal",
+           "load_journal_records"]
 
 _JOURNAL_NAME = "run_journal.jsonl"
 _CURSOR_NAME = "run_cursor.json"
@@ -61,20 +62,26 @@ _CURSOR_NAME = "run_cursor.json"
 RETRY_FOLD_SALT = 0x7E7247
 
 
-def load_chunk_journal(path, event="chunk", key="start"):
-    """Valid committed-chunk records of an append-only fsync'd journal,
-    keyed by ``int(rec[key])`` for records whose ``"e"`` equals ``event``.
+def load_journal_records(path, truncate=True):
+    """Every valid complete record of an append-only fsync'd journal,
+    in order, plus the byte length of the journal's valid prefix.
 
-    THE shared torn-tail rule of every chunked-run journal in this repo
-    (the export supervisor's, the Monte-Carlo study engine's, the
-    dataset factory's): a crash can leave at most one torn final line,
-    which is skipped AND truncated away — appending a later run's
-    records after a newline-less fragment would weld two records into
-    one permanently unparseable line, silently discarding every later
-    commit on the NEXT resume.  Truncating costs at most one chunk's
-    recompute.
+    THE shared torn-tail rule of every journal in this repo (the export
+    supervisor's, the Monte-Carlo study engine's, the dataset
+    factory's, the serving result cache's): a crash can leave at most
+    one torn final line, which is skipped AND — when ``truncate`` —
+    truncated away: appending a later run's records after a
+    newline-less fragment would weld two records into one permanently
+    unparseable line, silently discarding every later commit on the
+    NEXT load.  Truncating costs at most one chunk's recompute.
+
+    Returns ``(records, valid_end)``; a missing journal is ``([], 0)``.
+    Callers doing open-time replay must hold whatever cross-process
+    lock guards their journal (no writer may be mid-append while the
+    tail is truncated) — the run journals are single-writer by
+    construction, the cache holds its flock.
     """
-    done = {}
+    records = []
     valid_end = 0
     try:
         with open(path, "rb") as f:
@@ -86,14 +93,22 @@ def load_chunk_journal(path, event="chunk", key="start"):
                 except json.JSONDecodeError:
                     break
                 valid_end += len(line)
-                if rec.get("e") == event:
-                    done[int(rec[key])] = rec
+                records.append(rec)
     except FileNotFoundError:
-        return done
-    if valid_end < os.path.getsize(path):
+        return records, 0
+    if truncate and valid_end < os.path.getsize(path):
         with open(path, "rb+") as f:
             f.truncate(valid_end)
-    return done
+    return records, valid_end
+
+
+def load_chunk_journal(path, event="chunk", key="start"):
+    """Valid committed-chunk records of an append-only fsync'd journal,
+    keyed by ``int(rec[key])`` for records whose ``"e"`` equals
+    ``event`` — the chunked-run view over
+    :func:`load_journal_records` (one torn-tail rule in the repo)."""
+    records, _ = load_journal_records(path)
+    return {int(rec[key]): rec for rec in records if rec.get("e") == event}
 
 
 class RunResult:
@@ -118,10 +133,14 @@ class RunResult:
         The export's stage-telemetry snapshot (the manifest's
         ``pipeline`` key): per-stage busy seconds, fetched bytes, queue
         depths, and the named bottleneck stage.
+    integrity : dict or None
+        The run's integrity counters (the manifest's ``integrity``
+        key) when the checksum lattice was armed: checks, checksum/
+        audit mismatches, healed chunks, and the ``sdc_suspect`` flag.
     """
 
     def __init__(self, paths, quarantined, retried, recovered, degraded,
-                 hashes, out_dir, pipeline=None):
+                 hashes, out_dir, pipeline=None, integrity=None):
         self.paths = list(paths)
         self.quarantined = sorted(quarantined)
         self.retried = sorted(retried)
@@ -130,6 +149,7 @@ class RunResult:
         self.hashes = dict(hashes)
         self.out_dir = out_dir
         self.pipeline = pipeline
+        self.integrity = integrity
 
     def __repr__(self):
         return (f"RunResult(files={len(self.paths)}, "
@@ -180,47 +200,30 @@ class RunSupervisor:
     # -- resume state ------------------------------------------------------
 
     def _load_previous(self):
-        """Rebuild the hash record from the manifest and the journal.
-
-        The journal is append-only and fsync'd per commit; a crash can
-        leave at most one torn final line.  That tail is skipped AND
-        truncated away — appending this run's records after a fragment
-        with no newline would weld them into one permanently unparseable
-        line, silently discarding every later commit on the NEXT resume.
-        Truncating costs at most one chunk's re-verify."""
+        """Rebuild the hash record from the manifest and the journal —
+        replayed through the repo's ONE torn-tail loader
+        (:func:`load_journal_records`): a newline-less tail from a
+        crash is skipped and truncated, costing at most one chunk's
+        re-verify."""
         from ..io.export import _load_manifest
 
         man = _load_manifest(self.out_dir)
         if man is not None:
             self._hashes.update(man.get("files", {}))
-        valid_end = 0
-        try:
-            with open(self.journal_path, "rb") as f:
-                for line in f:
-                    if not line.endswith(b"\n"):
-                        break  # torn mid-write: unsafe to append after
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break
-                    valid_end += len(line)
-                    if rec.get("e") == "commit":
-                        self._hashes.update(rec.get("files", {}))
-                    elif rec.get("e") in ("rfi", "rfi_retry"):
-                        # replay the scenario-truth record so a resumed
-                        # export's manifest summary stays COMPLETE (the
-                        # skipped committed chunks never re-observe)
-                        for i, c in zip(rec.get("obs", ()),
-                                        rec.get("cells", ())):
-                            if c:
-                                self._rfi_obs[int(i)] = int(c)
-                            else:
-                                self._rfi_obs.pop(int(i), None)
-        except FileNotFoundError:
-            return
-        if valid_end < os.path.getsize(self.journal_path):
-            with open(self.journal_path, "rb+") as f:
-                f.truncate(valid_end)
+        records, _ = load_journal_records(self.journal_path)
+        for rec in records:
+            if rec.get("e") == "commit":
+                self._hashes.update(rec.get("files", {}))
+            elif rec.get("e") in ("rfi", "rfi_retry"):
+                # replay the scenario-truth record so a resumed
+                # export's manifest summary stays COMPLETE (the
+                # skipped committed chunks never re-observe)
+                for i, c in zip(rec.get("obs", ()),
+                                rec.get("cells", ())):
+                    if c:
+                        self._rfi_obs[int(i)] = int(c)
+                    else:
+                        self._rfi_obs.pop(int(i), None)
 
     # -- exporter hooks ----------------------------------------------------
 
@@ -358,6 +361,14 @@ class RunSupervisor:
         self._sync_journal()
         self._commits += 1
         self._write_cursor()
+        if self.faults is not None:
+            # disk.bitrot injection: decay a just-committed file AFTER
+            # its sha256 became the durable record — exactly what the
+            # scrub layer exists to find (tests only)
+            from .integrity import maybe_bitrot
+
+            for p, _sha in results:
+                maybe_bitrot(self.faults, p)
         self._maybe_kill(kind, ident)
 
     def record_retry(self, group, retried, still_bad):
@@ -370,6 +381,23 @@ class RunSupervisor:
             "e": "retry", "group": int(group),
             "obs": [int(i) for i in retried],
             "still_bad": [int(i) for i in still_bad]})
+        self._sync_journal()
+
+    def record_integrity(self, kind, start, obs=(), healed=True,
+                         detail=None):
+        """Durable record of one integrity event (``kind`` is
+        ``"checksum"`` — the lattice caught a fetch-window corruption —
+        or ``"audit"`` — duplicate execution caught the device
+        disagreeing with itself): which chunk, which observations, and
+        whether verified re-execution healed it.  Rides the same
+        fsync'd append-only journal as every other durable claim, so a
+        resumed run (and the operator) sees the full corruption
+        history."""
+        rec = {"e": "integrity", "kind": str(kind), "start": int(start),
+               "obs": [int(i) for i in obs], "healed": bool(healed)}
+        if detail:
+            rec["detail"] = dict(detail)
+        self._append_journal(rec)
         self._sync_journal()
 
     def note_degraded(self):
@@ -453,7 +481,8 @@ class RunSupervisor:
         self.close()
         return RunResult(paths, self._still_bad, self._retried,
                          self._recovered, self._degraded, self._hashes,
-                         self.out_dir, pipeline=man.get("pipeline"))
+                         self.out_dir, pipeline=man.get("pipeline"),
+                         integrity=man.get("integrity"))
 
 
 class ProcessSupervisor:
@@ -675,7 +704,10 @@ def supervised_export(ens, n_obs, out_dir, template, pulsar, *,
         retry: re-run quarantined observations once with a fresh key
             fold; ``False`` records them as bad immediately.
         **export_kw: forwarded to ``export_ensemble_psrfits`` (seed, dms,
-            noise_norms, chunk_size, writers, obs_per_file, ...).
+            noise_norms, chunk_size, writers, obs_per_file,
+            ``integrity=`` — the silent-corruption defense of
+            :mod:`psrsigsim_tpu.runtime.integrity`, which needs exactly
+            this supervised path for its durable event journal — ...).
 
     Returns:
         :class:`RunResult`.

@@ -218,12 +218,28 @@ class ResultCache:
         and folds any peer-appended tail in — the disk path detected a
         peer's verify-drop by the artifact file vanishing, and a tier
         that never touches the file needs this bounded-staleness check
-        instead.  0 checks on every hit (tests).
+        instead.  The SAME heartbeat rate-limits the hot tier's
+        integrity spot check: a hot hit re-hashes its in-memory payload
+        against the journal's sha256 at most once per interval, so
+        in-process memory corruption cannot keep serving wrong bytes
+        from the zero-disk-read fast path (``hot_spot_checks`` /
+        ``hot_spot_errors``; a failed check evicts the entry and the
+        hit falls through to disk).  0 checks on every hit (tests).
+    scrub_interval_s : float
+        Incremental background scrub cadence: at most once per this
+        interval (piggybacked on ``get`` traffic — no thread), ONE
+        indexed artifact is re-hashed against its journal record;
+        bit-rot found this way is verify-dropped (journaled, under the
+        cross-process lock) and the artifact recommits on its next
+        request — found before a reader is.  Default
+        ``PSS_CACHE_SCRUB_S`` (5 s); 0 disables.  ``scrub_step`` runs
+        the same check on demand (the fleet/bench gates call it).
     """
 
     def __init__(self, cache_dir, verify=False, faults=None,
                  claim_timeout_s=5.0, compact_min_dead=64,
-                 hot_max_bytes=None, hot_tail_check_s=0.05):
+                 hot_max_bytes=None, hot_tail_check_s=0.05,
+                 scrub_interval_s=None):
         self.cache_dir = str(cache_dir)
         self.results_dir = os.path.join(self.cache_dir, "results")
         self.claims_dir = os.path.join(self.cache_dir, _CLAIMS_DIR)
@@ -263,6 +279,22 @@ class ResultCache:
         # is still indexed
         self._last_read = None
         self.tmp_sweeps = 0    # dead writers' partial tmps removed at open
+        # incremental bit-rot scrub (runtime/integrity.py layer 3):
+        # bounded re-hash per heartbeat, rotating over the index
+        if scrub_interval_s is None:
+            try:
+                scrub_interval_s = float(
+                    os.environ.get("PSS_CACHE_SCRUB_S", 5.0))
+            except ValueError:
+                scrub_interval_s = 5.0
+        self.scrub_interval_s = float(scrub_interval_s)
+        self._last_scrub = time.monotonic()
+        self._scrub_pos = 0
+        self.scrubbed = 0        # artifacts re-hashed clean by the scrub
+        self.scrub_errors = 0    # bit-rot found (and verify-dropped)
+        self.hot_spot_checks = 0  # in-memory payload re-hashes
+        self.hot_spot_errors = 0  # hot entries evicted as corrupt
+        self._last_hot_check = 0.0
         with self._lock, self._flocked():
             self._open_journal_locked()
         self._sweep_dead_tmps()
@@ -288,35 +320,30 @@ class ResultCache:
     # -- open / replay / compaction ---------------------------------------
 
     def _open_journal_locked(self):
-        """Open-time replay under the cross-process lock: torn-tail
-        truncation (no writer is mid-append while we hold the flock, so
-        a newline-less tail is definitely a crash remnant), then
-        compaction when dead records passed the threshold.  Caller holds
-        the thread lock and the flock."""
-        valid_end = 0
-        replayed = 0
+        """Open-time replay under the cross-process lock, through the
+        repo's ONE torn-tail loader
+        (:func:`~psrsigsim_tpu.runtime.supervisor.load_journal_records`
+        — no writer is mid-append while we hold the flock, so a
+        newline-less tail is definitely a crash remnant and is
+        truncated), then compaction when dead records passed the
+        threshold.  Caller holds the thread lock and the flock.  (The
+        miss-path ``_refresh_locked`` deliberately stays hand-rolled:
+        it runs WITHOUT the flock, where a peer may be mid-append and
+        an incomplete tail must be left alone, never truncated.)"""
+        from ..runtime.supervisor import load_journal_records
+
+        records, valid_end = load_journal_records(self.journal_path)
         try:
-            with open(self.journal_path, "rb") as f:
-                for line in f:
-                    if not line.endswith(b"\n"):
-                        break
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break
-                    valid_end += len(line)
-                    replayed += 1
-                    self._apply_record(rec)
+            st = os.stat(self.journal_path)
         except FileNotFoundError:
             self._journal_pos = 0
             self._journal_ino = None
             return
-        if valid_end < os.path.getsize(self.journal_path):
-            with open(self.journal_path, "rb+") as f:
-                f.truncate(valid_end)
+        for rec in records:
+            self._apply_record(rec)
         self._journal_pos = valid_end
-        self._journal_ino = os.stat(self.journal_path).st_ino
-        dead = replayed - len(self._index)
+        self._journal_ino = st.st_ino
+        dead = len(records) - len(self._index)
         if dead >= self.compact_min_dead:
             self._compact_locked(dead)
 
@@ -504,6 +531,73 @@ class ResultCache:
             self.dropped += len(bad)
             return self.verified, self.dropped
 
+    # -- incremental bit-rot scrub -----------------------------------------
+
+    def _maybe_scrub(self):
+        """The per-heartbeat scrub budget: at most once per
+        ``scrub_interval_s``, re-hash ONE indexed artifact (bounded
+        work, piggybacked on request traffic — no background thread to
+        supervise)."""
+        if self.scrub_interval_s <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_scrub < self.scrub_interval_s:
+                return
+            self._last_scrub = now
+        self.scrub_step(1)
+
+    def scrub_step(self, max_items=1):
+        """Re-hash up to ``max_items`` indexed artifacts against their
+        journal records, rotating through the index forever.  Bit-rot
+        (or a vanished file) is VERIFY-DROPPED under the cross-process
+        lock — journaled ``drop`` record, hot/memo eviction, artifact
+        unlinked — so peers see it too and the next request for that
+        hash recomputes and recommits: self-healing, journal-coherent.
+        Returns the list of hashes dropped this step."""
+        dropped = []
+        with self._lock:
+            # one ring snapshot per step (not per item — a large fleet
+            # index must not be re-sorted under the lock n times)
+            ring = sorted(self._index)
+        for _ in range(int(max_items)):
+            with self._lock:
+                if not ring:
+                    break
+                h = ring[self._scrub_pos % len(ring)]
+                self._scrub_pos += 1
+                rec = self._index.get(h)
+                if rec is None:
+                    continue   # dropped since the snapshot
+            path = self._artifact_path(h)
+            try:
+                hasher = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for block in iter(lambda: f.read(1 << 20), b""):
+                        hasher.update(block)
+                ok = hasher.hexdigest() == rec["sha256"]
+            except OSError:
+                ok = False
+            with self._lock:
+                if h not in self._index:
+                    continue   # dropped meanwhile (peer / verify)
+                if ok:
+                    self.scrubbed += 1
+                    continue
+                with self._flocked():
+                    del self._index[h]
+                    self._hot.pop(h)
+                    if self._last_read is not None \
+                            and self._last_read[0] == h:
+                        self._last_read = None
+                    self._append_record_locked({"e": "drop", "hash": h})
+                    with contextlib.suppress(OSError):
+                        os.unlink(path)
+                self.scrub_errors += 1
+                self.dropped += 1
+                dropped.append(h)
+        return dropped
+
     # -- lookup / commit ---------------------------------------------------
 
     def _artifact_path(self, h):
@@ -532,6 +626,7 @@ class ResultCache:
         shared dir are served without any restart.  A hit never touches
         the device — the serving engine's device-call counter is
         asserted against exactly this."""
+        self._maybe_scrub()
         with self._lock:
             rec = self._index.get(h)
             if rec is None:
@@ -545,6 +640,28 @@ class ResultCache:
                 return None
             ent = self._hot.get(h)
             if ent is not None:
+                # rate-limited in-memory integrity spot check (same
+                # heartbeat as tail coherence): the hot tier serves
+                # with zero disk reads, so a flipped bit in THIS
+                # process's memory would otherwise be served forever —
+                # re-hash the payload against the journal's sha256 and
+                # evict on mismatch (the hit falls through to disk,
+                # whose bytes are scrub-guarded separately)
+                now = time.monotonic()
+                if now - self._last_hot_check >= self.hot_tail_check_s:
+                    self._last_hot_check = now
+                    self.hot_spot_checks += 1
+                    if hashlib.sha256(ent[0]).hexdigest() \
+                            != rec["sha256"]:
+                        self._hot.pop(h)
+                        self.hot_spot_errors += 1
+                        ent = None
+                        # the last-read memo aliases the SAME decoded
+                        # array/payload from the same disk read: it is
+                        # equally suspect and must not catch the
+                        # fall-through — force the disk path
+                        self._last_read = None
+            if ent is not None:
                 self.hits += 1
                 self.hot_hits += 1
                 return ent[1]
@@ -552,7 +669,11 @@ class ResultCache:
         if memo is not None and memo[0] == h:
             # hot tier disabled (or entry evicted) but this very hash
             # was the last disk read: re-validate with one cheap stat
-            # instead of re-opening and re-decoding the artifact
+            # instead of re-opening and re-decoding the artifact.  The
+            # memo is still IN-PROCESS memory, so it gets the same
+            # rate-limited integrity spot check as the hot tier — the
+            # stat proves the DISK didn't change, not that our pages
+            # didn't
             try:
                 st = os.stat(self._artifact_path(h))
             except OSError:
@@ -560,7 +681,19 @@ class ResultCache:
             if (st is not None and st.st_ino == memo[1]
                     and st.st_size == memo[2]):
                 with self._lock:
-                    if h in self._index:    # not dropped meanwhile
+                    ok = h in self._index    # not dropped meanwhile
+                    if ok:
+                        now = time.monotonic()
+                        if (now - self._last_hot_check
+                                >= self.hot_tail_check_s):
+                            self._last_hot_check = now
+                            self.hot_spot_checks += 1
+                            if hashlib.sha256(memo[4]).hexdigest() \
+                                    != rec["sha256"]:
+                                self.hot_spot_errors += 1
+                                self._last_read = None
+                                ok = False   # fall through to disk
+                    if ok:
                         self.hits += 1
                         self.memo_hits += 1
                         return memo[3]
@@ -584,7 +717,10 @@ class ResultCache:
             self.hits += 1
             self.disk_hits += 1
             self._hot.put(h, (data, arr), len(data))
-            self._last_read = (h, st.st_ino, st.st_size, arr)
+            # the payload bytes ride the memo so its spot check can
+            # re-hash against the journal sha (the decoded array alone
+            # cannot reproduce the artifact's .npy bytes)
+            self._last_read = (h, st.st_ino, st.st_size, arr, data)
         return arr
 
     def _claim(self, h):
@@ -715,6 +851,14 @@ class ResultCache:
                         self._hot.put(h, (payload, ro), len(payload))
                 rec = self._index[h]
                 puts = self._puts
+            # disk.bitrot arm (tests): decay the artifact right after
+            # its sha256 became the journal's record — found by the
+            # incremental scrub (verify-drop + recommit-on-next-
+            # request), never served as good bytes
+            if self._faults is not None:
+                from ..runtime.integrity import maybe_bitrot
+
+                maybe_bitrot(self._faults, path, token=h)
             # serve.kill: die AFTER the durable commit but BEFORE the
             # claim release — the relaunch must find exactly
             # `after_puts` artifacts, verified and servable, and peers
@@ -772,7 +916,13 @@ class ResultCache:
                     "hot_bytes": self._hot.bytes,
                     "hot_max_bytes": self._hot.max_bytes,
                     "hot_evictions": self._hot.evictions,
-                    "tmp_sweeps": self.tmp_sweeps}
+                    "tmp_sweeps": self.tmp_sweeps,
+                    # integrity layer 3: incremental scrub + hot-tier
+                    # spot checks (runtime/integrity.py)
+                    "scrubbed": self.scrubbed,
+                    "scrub_errors": self.scrub_errors,
+                    "hot_spot_checks": self.hot_spot_checks,
+                    "hot_spot_errors": self.hot_spot_errors}
 
     def close(self):
         with self._lock:

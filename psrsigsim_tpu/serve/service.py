@@ -147,12 +147,27 @@ class SimulationService:
         In-memory hot-tier byte budget forwarded to
         :class:`~psrsigsim_tpu.serve.ResultCache` (default: the
         ``PSS_CACHE_HOT_MB`` env, 256 MiB; 0 disables the tier).
+    integrity : optional
+        The silent-corruption defense
+        (:mod:`psrsigsim_tpu.runtime.integrity`): ``None`` consults
+        ``PSS_INTEGRITY`` (unset = off, the zero-cost default).  Armed,
+        every executed batch's device output carries a device-computed
+        per-row digest re-checked on the host copy before any row is
+        cached or served (closing the fetch->respond window), a
+        deterministic sample of batches is duplicate-executed and
+        compared claim-for-claim (mismatch -> verified re-execution
+        heals, or :class:`~psrsigsim_tpu.runtime.IntegrityError` fails
+        the batch's requests with the evidence), cache commits carry
+        the attested ``dig`` in their journal meta, and the sticky
+        ``sdc_suspect`` flag surfaces in ``health()`` for the fleet's
+        breaker/eject path.
     """
 
     def __init__(self, cache_dir=None, widths=DEFAULT_WIDTHS, max_queue=64,
                  batch_window_s=0.002, retry_after_s=0.5, telemetry=None,
                  faults=None, verify_cache=False, compile_cache_dir=None,
-                 max_done=1024, replica_id=None, cache_hot_bytes=None):
+                 max_done=1024, replica_id=None, cache_hot_bytes=None,
+                 integrity=None):
         import os
 
         if compile_cache_dir is None and cache_dir is not None:
@@ -168,6 +183,10 @@ class SimulationService:
         self.timers = (telemetry if telemetry is not None
                        else StageTimers(extra_stages=SERVE_STAGES,
                                         latency_stages=SERVE_LATENCY_STAGES))
+        from ..runtime.integrity import resolve_integrity
+
+        self.integrity = resolve_integrity(integrity, fingerprint="serve",
+                                           faults=faults)
         self.max_queue = int(max_queue)
         self.batch_window_s = float(batch_window_s)
         self.retry_after_s = float(retry_after_s)
@@ -451,6 +470,11 @@ class SimulationService:
             "served": served,
             "shed": shed,
             "cache_degraded": degraded,
+            # sticky SDC verdict for the fleet's breaker/eject path: a
+            # replica whose device ever disagreed with its own
+            # re-execution is suspect hardware — route around it
+            "sdc_suspect": (self.integrity.sdc_suspect
+                            if self.integrity is not None else False),
             "device_calls": reg["device_calls"],
             "programs": reg["programs"],
             "compile_counts": reg["compile_counts"],
@@ -491,6 +515,8 @@ class SimulationService:
             }
         out["stages"] = self.timers.snapshot()
         out["programs"] = self.registry.stats()
+        if self.integrity is not None:
+            out["integrity"] = self.integrity.stats()
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         if self.frontend is not None:
@@ -616,9 +642,14 @@ class SimulationService:
         self.timers.add("batch", time.perf_counter() - t0)
 
         t0 = time.perf_counter()
-        out = np.asarray(
-            self.registry.execute(gh, width, keys, dms, norms, nulls,
-                                  sc=sc))
+        dig_row = None
+        if self.integrity is None:
+            out = np.asarray(
+                self.registry.execute(gh, width, keys, dms, norms, nulls,
+                                      sc=sc))
+        else:
+            out, dig_row = self._execute_checked(gh, width, keys, dms,
+                                                 norms, nulls, sc, batch)
         compute_s = time.perf_counter() - t0
         self.timers.add("compute", compute_s)
         self._observe_service_time(compute_s / len(batch))
@@ -632,9 +663,14 @@ class SimulationService:
         now = time.perf_counter()
         for i, r in enumerate(batch):
             arr = np.ascontiguousarray(out[i])
+            meta = {"geom": gh[:12]}
+            if dig_row is not None:
+                # the device-attested claim rides the cache journal's
+                # commit record (checked equal to these bytes above)
+                meta["dig"] = int(dig_row[i])
             if self.cache is not None:
                 try:
-                    self.cache.put(r.id, arr, meta={"geom": gh[:12]})
+                    self.cache.put(r.id, arr, meta=meta)
                     with self._cond:
                         self.cache_degraded = False
                     self.timers.gauge("cache_degraded", 0)
@@ -656,6 +692,76 @@ class SimulationService:
             self.served += len(batch)
             self._evict_terminal()
         self.timers.add("respond", time.perf_counter() - t0)
+
+    def _execute_checked(self, gh, width, keys, dms, norms, nulls, sc,
+                         batch):
+        """Device execution under the integrity lattice + audit
+        (:mod:`psrsigsim_tpu.runtime.integrity`): the device output's
+        per-row digest is computed ON DEVICE, the host copy is
+        re-digested and compared before any row can reach the cache or
+        a client, and a deterministic sample of batches (keyed by the
+        head request's spec hash, so identical traffic audits
+        identically) is duplicate-executed and compared
+        claim-for-claim.  Disagreements heal through verified
+        re-execution — same program, same keys, so healed bytes equal a
+        clean batch's bit for bit; an unhealable disagreement raises
+        :class:`~psrsigsim_tpu.runtime.IntegrityError`, failing exactly
+        this batch's requests with the evidence attached (the batcher's
+        existing poisoned-batch path).  Returns ``(host_out,
+        per_row_digests)``."""
+        from ..runtime.integrity import device_digest_rows, digest_rows
+
+        checker = self.integrity
+        token = batch[0].id
+
+        def _exec():
+            dev = self.registry.execute(gh, width, keys, dms, norms,
+                                        nulls, sc=sc)
+            dev = checker.apply_sdc(dev, token=token)
+            return dev, np.asarray(device_digest_rows(dev), np.uint32)
+
+        dev, dig_dev = _exec()
+        out = checker.corrupt_host(np.asarray(dev), token=token)
+        host_dig = digest_rows(out)
+        bad = checker.check_rows(dig_dev, host_dig, producer="serve")
+        audit = checker.audit_chunk(token)
+        if not bad and not audit:
+            return out, host_dig
+
+        out_a = None
+        if not bad:
+            # audit-only: serving programs are AOT-compiled once per
+            # (geometry, width) — duplicate execution re-runs the same
+            # executable (a fresh compile would break the bounded-cold-
+            # start contract), which is exactly the transient-SDC screen
+            out_a = _exec()
+            mism = [int(j) for j in np.nonzero(out_a[1] != dig_dev)[0]]
+            checker.note_audit(mism)
+            if not mism:
+                return out, host_dig
+
+        evidence = {"producer": "serve", "geometry": gh[:12],
+                    "spec": token[:12], "lattice_rows": [int(j)
+                                                         for j in bad]}
+
+        def reexecute():
+            a = out_a if out_a is not None else _exec()
+            b = _exec()
+            return np.asarray(a[0]), a[1], b[1]
+
+        def verify(res):
+            fetched, dig_a, dig_b = res
+            return (np.array_equal(dig_a, dig_b)
+                    and np.array_equal(digest_rows(fetched), dig_a))
+
+        fetched, dig_a, _ = checker.heal_verified(
+            reexecute, verify, producer="serve", ident=token[:12],
+            evidence=evidence)
+        sdc_rows = [int(j) for j in np.nonzero(dig_a != dig_dev)[0]]
+        if sdc_rows and bad:
+            checker.note_audit(sdc_rows)
+        self.timers.count("integrity_healed")
+        return fetched, dig_a
 
     def _batch_loop(self):
         while True:

@@ -64,6 +64,14 @@ def main(argv=None):
                     help="per-observation DMs in runs of this length "
                          "(dm = 10 + 5 * (i // run_len)) — the per-pulsar "
                          "grouped packed layout; 0 = no per-obs DMs")
+    ap.add_argument("--integrity", type=float, default=None, metavar="FRAC",
+                    help="arm the integrity lattice with this audit "
+                         "fraction (runtime/integrity.py); the plan may "
+                         "then carry device.sdc / host.corrupt / "
+                         "disk.bitrot points")
+    ap.add_argument("--scrub", action="store_true",
+                    help="run a full scrub pass over out_dir AFTER the "
+                         "export (quarantining bit-rot) and report it")
     args = ap.parse_args(argv)
 
     import jax
@@ -93,11 +101,16 @@ def main(argv=None):
         ens, args.n_obs, args.out_dir, TEMPLATE, ens.pulsar, seed=SEED,
         chunk_size=args.chunk_size, writers=args.writers, dms=dms,
         obs_per_file=args.obs_per_file, faults=plan,
-        pipeline_depth=args.pipeline_depth,
+        pipeline_depth=args.pipeline_depth, integrity=args.integrity,
         resume="verify" if args.resume_mode == "verify" else True)
-    print(json.dumps({
-        "paths": res.paths, "quarantined": res.quarantined,
-        "retried": res.retried, "degraded": res.degraded}))
+    out = {"paths": res.paths, "quarantined": res.quarantined,
+           "retried": res.retried, "degraded": res.degraded,
+           "integrity": res.integrity}
+    if args.scrub:
+        from psrsigsim_tpu.runtime import scrub_export_dir
+
+        out["scrub"] = scrub_export_dir(args.out_dir)
+    print(json.dumps(out))
     return 0
 
 

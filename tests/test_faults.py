@@ -253,6 +253,428 @@ class TestWriterPoolSelfHealing:
         assert _same_bytes(serial_ref, res.paths)
 
 
+def _same_files(a_paths, b_paths):
+    return all(open(a, "rb").read() == open(b, "rb").read()
+               for a, b in zip(a_paths, b_paths))
+
+
+class TestIntegrityExport:
+    """The corruption fault matrix, export producer: every injected
+    flip is detected, healed by verified re-execution, and the healed
+    run's files are byte-identical to a clean run — with zero false
+    positives when nothing is injected (runtime/integrity.py)."""
+
+    @pytest.fixture(scope="class")
+    def clean(self, ens, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("integ") / "clean")
+        res = supervised_export(ens, 5, out, TEMPLATE, ens.pulsar, seed=3,
+                                chunk_size=3, writers=1)
+        return res.paths
+
+    def test_clean_run_with_full_audit_is_false_positive_free(
+            self, ens, clean, tmp_path):
+        from psrsigsim_tpu.runtime import IntegrityChecker
+
+        ck = IntegrityChecker(audit_frac=1.0)
+        res = supervised_export(ens, 5, str(tmp_path / "on"), TEMPLATE,
+                                ens.pulsar, seed=3, chunk_size=3,
+                                writers=1, integrity=ck)
+        st = ck.stats()
+        assert st["checks"] > 0 and st["audits"] > 0
+        assert st["checksum_mismatches"] == 0
+        assert st["audit_mismatches"] == 0 and not st["sdc_suspect"]
+        assert _same_files(clean, res.paths)
+        # the verdict is part of the durable record
+        assert res.integrity is not None and res.integrity["audits"] > 0
+
+    def test_host_corrupt_detected_healed_byte_identical(self, ens, clean,
+                                                         tmp_path):
+        from psrsigsim_tpu.runtime import IntegrityChecker
+
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"host.corrupt": {"after_start": 0}})
+        ck = IntegrityChecker(audit_frac=0.0)
+        res = supervised_export(ens, 5, str(tmp_path / "out"), TEMPLATE,
+                                ens.pulsar, seed=3, chunk_size=3,
+                                writers=1, integrity=ck, faults=plan)
+        st = ck.stats()
+        assert st["checksum_mismatches"] == 1 and st["healed_chunks"] == 1
+        assert not st["sdc_suspect"]   # the device was never wrong
+        assert _same_files(clean, res.paths)
+        events = [json.loads(line) for line in
+                  open(os.path.join(str(tmp_path / "out"),
+                                    "run_journal.jsonl"))]
+        integ = [e for e in events if e["e"] == "integrity"]
+        assert integ and integ[0]["kind"] == "checksum" \
+            and integ[0]["healed"]
+
+    def test_device_sdc_caught_by_audit_healed_byte_identical(
+            self, ens, clean, tmp_path):
+        from psrsigsim_tpu.runtime import IntegrityChecker
+
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"device.sdc": {"after_start": 0}})
+        ck = IntegrityChecker(audit_frac=1.0)
+        res = supervised_export(ens, 5, str(tmp_path / "out"), TEMPLATE,
+                                ens.pulsar, seed=3, chunk_size=3,
+                                writers=1, integrity=ck, faults=plan)
+        st = ck.stats()
+        # the lattice CANNOT see SDC (the digest attests the wrong
+        # bytes); only the duplicate execution disagrees
+        assert st["checksum_mismatches"] == 0
+        assert st["audit_mismatches"] == 1 and st["sdc_suspect"]
+        assert st["healed_chunks"] == 1
+        assert _same_files(clean, res.paths)
+
+    def test_disk_bitrot_scrubbed_and_resume_heals(self, ens, clean,
+                                                   tmp_path):
+        from psrsigsim_tpu.runtime import scrub_export_dir
+
+        out = str(tmp_path / "out")
+        plan = FaultPlan(str(tmp_path / "p"),
+                         {"disk.bitrot": {"match": "obs_00001"}})
+        supervised_export(ens, 5, out, TEMPLATE, ens.pulsar, seed=3,
+                          chunk_size=3, writers=1, faults=plan)
+        rep = scrub_export_dir(out)
+        assert rep["bad"] == ["obs_00001.fits"]
+        assert os.path.exists(os.path.join(out,
+                                           "obs_00001.fits.quarantine"))
+        # the very next resume re-runs exactly the quarantined file
+        res = supervised_export(ens, 5, out, TEMPLATE, ens.pulsar, seed=3,
+                                chunk_size=3, writers=1)
+        assert _same_files(clean, res.paths)
+        assert scrub_export_dir(out)["bad"] == []
+
+    def test_integrity_requires_supervision(self, ens, tmp_path):
+        from psrsigsim_tpu.io.export import export_ensemble_psrfits
+
+        with pytest.raises(ValueError, match="requires supervision"):
+            export_ensemble_psrfits(ens, 2, str(tmp_path / "out"),
+                                    TEMPLATE, ens.pulsar, integrity=True)
+
+    def test_integrity_off_is_exactly_the_old_path(self, ens, tmp_path):
+        """Disabled == current behavior: no checker, no digest element
+        on yielded chunks, no integrity record — the pre-integrity
+        code path verbatim (the compiled programs are the same registry
+        entries either way; byte-identity is pinned by every clean-vs-
+        integrity-on test above)."""
+        res = supervised_export(ens, 2, str(tmp_path / "out"), TEMPLATE,
+                                ens.pulsar, seed=3, chunk_size=2,
+                                writers=1)
+        assert res.integrity is None
+        blocks = [b for _, b in ens.iter_chunks(2, chunk_size=2, seed=3,
+                                                quantized=True)]
+        assert all(len(b) == 3 for b in blocks)   # no digest element
+
+
+class TestIntegrityMC:
+    """Corruption matrix, Monte-Carlo study producer."""
+
+    @pytest.fixture(scope="class")
+    def make_study(self):
+        from psrsigsim_tpu.mc import MonteCarloStudy
+        from psrsigsim_tpu.mc.priors import Uniform
+        from psrsigsim_tpu.simulate import Simulation
+
+        cfg = {
+            "fcent": 1400.0, "bandwidth": 400.0, "sample_rate": 0.2048,
+            "Nchan": 4, "sublen": 0.5, "fold": True, "period": 0.005,
+            "Smean": 0.05, "profiles": [0.5, 0.05, 1.0], "tobs": 1.0,
+            "name": "J0000+0000", "dm": 10.0, "aperture": 100.0,
+            "area": 5500.0, "Tsys": 35.0, "tscope_name": "T",
+            "system_name": "S", "rcvr_fcent": 1400, "rcvr_bw": 400,
+            "rcvr_name": "R", "backend_samprate": 12.5,
+            "backend_name": "B",
+        }
+
+        def make():
+            return MonteCarloStudy.from_simulation(
+                Simulation(psrdict=dict(cfg)),
+                {"dm": Uniform(5.0, 20.0)}, seed=3)
+
+        return make
+
+    @pytest.fixture(scope="class")
+    def mc_clean(self, make_study, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("integ_mc") / "clean")
+        make_study().run(24, chunk_size=8, out_dir=out)
+        return open(os.path.join(out, "trials.f32"), "rb").read()
+
+    def test_matrix_detect_heal_and_false_positive_free(
+            self, make_study, mc_clean, tmp_path):
+        from psrsigsim_tpu.runtime import IntegrityChecker, scrub_mc_dir
+
+        # clean, full audit: zero mismatches, identical artifact, the
+        # journal carries the device-attested dig claim
+        ck = IntegrityChecker(audit_frac=1.0)
+        out = str(tmp_path / "on")
+        make_study().run(24, chunk_size=8, out_dir=out, integrity=ck)
+        st = ck.stats()
+        assert st["checksum_mismatches"] == 0 \
+            and st["audit_mismatches"] == 0 and st["audits"] == 3
+        assert open(os.path.join(out, "trials.f32"), "rb").read() \
+            == mc_clean
+        rec = json.loads(open(os.path.join(out,
+                                           "mc_journal.jsonl")).readline())
+        assert "dig" in rec
+        man = json.load(open(os.path.join(out, "study_manifest.json")))
+        assert man["integrity"]["audits"] == 3
+
+        # host.corrupt: lattice detects, heal is bit-identical
+        ck2 = IntegrityChecker(audit_frac=0.0)
+        plan = FaultPlan(str(tmp_path / "p2"),
+                         {"host.corrupt": {"after_start": 8}})
+        out2 = str(tmp_path / "hc")
+        make_study().run(24, chunk_size=8, out_dir=out2, integrity=ck2,
+                         faults=plan)
+        st2 = ck2.stats()
+        assert st2["checksum_mismatches"] == 1 \
+            and st2["healed_chunks"] == 1
+        assert open(os.path.join(out2, "trials.f32"), "rb").read() \
+            == mc_clean
+
+        # device.sdc: only the duplicate execution can see it
+        ck3 = IntegrityChecker(audit_frac=1.0)
+        plan3 = FaultPlan(str(tmp_path / "p3"),
+                          {"device.sdc": {"after_start": 16}})
+        out3 = str(tmp_path / "sdc")
+        make_study().run(24, chunk_size=8, out_dir=out3, integrity=ck3,
+                         faults=plan3)
+        st3 = ck3.stats()
+        assert st3["checksum_mismatches"] == 0
+        assert st3["audit_mismatches"] == 1 and st3["sdc_suspect"]
+        assert open(os.path.join(out3, "trials.f32"), "rb").read() \
+            == mc_clean
+
+        # disk.bitrot: scrub names the chunk, resume recomputes it
+        plan4 = FaultPlan(str(tmp_path / "p4"),
+                          {"disk.bitrot": {"match": "start=8"}})
+        out4 = str(tmp_path / "rot")
+        make_study().run(24, chunk_size=8, out_dir=out4, faults=plan4)
+        assert scrub_mc_dir(out4)["bad"] == [8]
+        make_study().run(24, chunk_size=8, out_dir=out4, resume=True)
+        assert open(os.path.join(out4, "trials.f32"), "rb").read() \
+            == mc_clean
+        assert scrub_mc_dir(out4)["bad"] == []
+
+
+class TestIntegrityDataset:
+    """Corruption matrix, dataset-factory producer."""
+
+    SPEC = {
+        "nchan": 2, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+        "sample_rate_mhz": 0.2048, "tobs_s": 0.02, "period_s": 0.005,
+        "smean_jy": 0.05, "seed": 11, "n_records": 32, "shards": 2,
+        "dm": 10.0,
+        "priors": {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0}},
+    }
+
+    @staticmethod
+    def _sha(out_dir):
+        import hashlib
+
+        h = hashlib.sha256()
+        for p in sorted(glob.glob(os.path.join(out_dir,
+                                               "shard-*.records"))):
+            h.update(open(p, "rb").read())
+        return h.hexdigest()
+
+    @pytest.fixture(scope="class")
+    def ds_clean(self, tmp_path_factory):
+        from psrsigsim_tpu.datasets import DatasetFactory
+
+        out = str(tmp_path_factory.mktemp("integ_ds") / "clean")
+        DatasetFactory(self.SPEC).run(out, chunk_size=8)
+        return self._sha(out)
+
+    def test_matrix_detect_heal_and_false_positive_free(self, ds_clean,
+                                                        tmp_path):
+        from psrsigsim_tpu.datasets import DatasetFactory
+        from psrsigsim_tpu.runtime import (IntegrityChecker,
+                                           scrub_dataset_dir)
+
+        ck = IntegrityChecker(audit_frac=1.0)
+        out = str(tmp_path / "on")
+        res = DatasetFactory(self.SPEC).run(out, chunk_size=8,
+                                            integrity=ck)
+        st = ck.stats()
+        assert st["checksum_mismatches"] == 0 \
+            and st["audit_mismatches"] == 0 and st["audits"] == 4
+        assert self._sha(out) == ds_clean
+        assert res["integrity"]["audits"] == 4
+
+        ck2 = IntegrityChecker(audit_frac=0.0)
+        plan = FaultPlan(str(tmp_path / "p2"),
+                         {"host.corrupt": {"after_start": 8}})
+        out2 = str(tmp_path / "hc")
+        DatasetFactory(self.SPEC).run(out2, chunk_size=8, integrity=ck2,
+                                      faults=plan)
+        st2 = ck2.stats()
+        assert st2["checksum_mismatches"] == 1 \
+            and st2["healed_chunks"] == 1
+        assert self._sha(out2) == ds_clean
+
+        ck3 = IntegrityChecker(audit_frac=1.0)
+        plan3 = FaultPlan(str(tmp_path / "p3"),
+                          {"device.sdc": {"after_start": 16}})
+        out3 = str(tmp_path / "sdc")
+        DatasetFactory(self.SPEC).run(out3, chunk_size=8, integrity=ck3,
+                                      faults=plan3)
+        st3 = ck3.stats()
+        assert st3["checksum_mismatches"] == 0
+        assert st3["audit_mismatches"] == 1 and st3["sdc_suspect"]
+        assert self._sha(out3) == ds_clean
+
+        plan4 = FaultPlan(str(tmp_path / "p4"),
+                          {"disk.bitrot": {"match": "start=8"}})
+        out4 = str(tmp_path / "rot")
+        DatasetFactory(self.SPEC).run(out4, chunk_size=8, faults=plan4)
+        assert scrub_dataset_dir(out4)["bad"] == [8]
+        res4 = DatasetFactory(self.SPEC).run(out4, chunk_size=8,
+                                             resume=True)
+        assert res4["commits"] == 1 and res4["resumed_chunks"] == 3
+        assert self._sha(out4) == ds_clean
+        assert scrub_dataset_dir(out4)["bad"] == []
+
+
+class TestIntegrityServe:
+    """Corruption matrix, serving producer: batch lattice + audit,
+    sdc_suspect in health(), bit-rot scrub with recommit-on-next-
+    request, and the hot tier's in-memory spot check."""
+
+    SPEC = {"nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+            "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+            "period_s": 0.005, "smean_jy": 0.05, "seed": 3, "dm": 10.0}
+
+    @pytest.fixture(scope="class")
+    def serve_ref(self):
+        from psrsigsim_tpu.serve import SimulationService
+
+        svc = SimulationService(cache_dir=None, widths=(1,))
+        rid, _ = svc.submit(self.SPEC)
+        ref = np.array(svc.result(rid, timeout=120))
+        svc.drain()
+        return ref
+
+    def test_matrix_detect_heal_and_health_flags(self, serve_ref,
+                                                 tmp_path):
+        from psrsigsim_tpu.runtime import IntegrityChecker
+        from psrsigsim_tpu.serve import SimulationService
+
+        # clean, full audit: byte-identical, no mismatch, dig in the
+        # cache journal meta
+        svc = SimulationService(cache_dir=str(tmp_path / "c1"),
+                                widths=(1,),
+                                integrity=IntegrityChecker(audit_frac=1.0))
+        rid, _ = svc.submit(self.SPEC)
+        assert np.array_equal(svc.result(rid, timeout=120), serve_ref)
+        st = svc.integrity.stats()
+        assert st["audits"] == 1 and st["audit_mismatches"] == 0 \
+            and st["checksum_mismatches"] == 0
+        assert svc.health()["sdc_suspect"] is False
+        assert "integrity" in svc.metrics()
+        rec = json.loads(open(str(tmp_path / "c1" /
+                                  "cache_journal.jsonl")).readline())
+        assert "dig" in rec["meta"]
+        svc.drain()
+
+        # host.corrupt: lattice catches it before the cache/client
+        plan = FaultPlan(str(tmp_path / "p2"), {"host.corrupt": {}})
+        svc2 = SimulationService(
+            cache_dir=str(tmp_path / "c2"), widths=(1,),
+            integrity=IntegrityChecker(audit_frac=0.0), faults=plan)
+        rid2, _ = svc2.submit(self.SPEC)
+        assert np.array_equal(svc2.result(rid2, timeout=120), serve_ref)
+        st2 = svc2.integrity.stats()
+        assert st2["checksum_mismatches"] == 1 \
+            and st2["healed_chunks"] == 1
+        svc2.drain()
+
+        # device.sdc: the audit catches it; the replica flags itself
+        plan3 = FaultPlan(str(tmp_path / "p3"), {"device.sdc": {}})
+        svc3 = SimulationService(
+            cache_dir=str(tmp_path / "c3"), widths=(1,),
+            integrity=IntegrityChecker(audit_frac=1.0), faults=plan3)
+        rid3, _ = svc3.submit(self.SPEC)
+        assert np.array_equal(svc3.result(rid3, timeout=120), serve_ref)
+        st3 = svc3.integrity.stats()
+        assert st3["audit_mismatches"] == 1 and st3["sdc_suspect"]
+        assert svc3.health()["sdc_suspect"] is True
+        svc3.drain()
+
+    def test_disk_bitrot_scrub_drops_and_next_reader_recommits(
+            self, serve_ref, tmp_path):
+        from psrsigsim_tpu.serve import SimulationService
+
+        plan = FaultPlan(str(tmp_path / "p"), {"disk.bitrot": {}})
+        svc = SimulationService(cache_dir=str(tmp_path / "c"),
+                                widths=(1,), faults=plan)
+        rid, _ = svc.submit(self.SPEC)
+        svc.result(rid, timeout=120)
+        dropped = svc.cache.scrub_step(10)
+        assert dropped == [rid]
+        stats = svc.cache.stats()
+        assert stats["scrub_errors"] == 1 and stats["entries"] == 0
+        svc.drain()
+        # the next reader recomputes and recommits — served bytes are
+        # the clean ones, never the rotted artifact
+        svc2 = SimulationService(cache_dir=str(tmp_path / "c"),
+                                 widths=(1,))
+        rid2, _ = svc2.submit(self.SPEC)
+        assert np.array_equal(svc2.result(rid2, timeout=120), serve_ref)
+        assert svc2.registry.stats()["device_calls"] == 1
+        assert svc2.cache.stats()["entries"] == 1
+        svc2.drain()
+
+    def test_hot_tier_spot_check_evicts_corrupt_memory(self, tmp_path):
+        from psrsigsim_tpu.serve.cache import ResultCache
+
+        cache = ResultCache(str(tmp_path / "c"), hot_tail_check_s=0.0)
+        cache.put("deadbeef", np.arange(8, dtype=np.float32))
+        ent = cache._hot.get("deadbeef")
+        payload = bytearray(ent[0])
+        payload[20] ^= 0xFF   # in-process memory corruption
+        cache._hot.put("deadbeef", (bytes(payload), ent[1]), len(payload))
+        arr = cache.get("deadbeef")
+        st = cache.stats()
+        assert st["hot_spot_errors"] == 1 and st["disk_hits"] == 1
+        assert np.array_equal(arr, np.arange(8, dtype=np.float32))
+
+
+class TestIntegrityKillChaos:
+    """The subprocess chaos leg: device.sdc + SIGKILL mid-run, then an
+    integrity-armed resume — the audit catches the corruption, the kill
+    loses nothing, and the final corpus is byte-identical to a clean
+    export (tests/fault_runner.py --integrity)."""
+
+    def test_sdc_plus_sigkill_resume_byte_identical(self, clean_dir,
+                                                    tmp_path):
+        out = str(tmp_path / "out")
+        plan_file = _write_plan(
+            tmp_path, "ichaos",
+            {"device.sdc": {"after_start": 0},
+             "run.kill": {"after_start": 0}})
+        _run_export(out, plan_file=plan_file, expect_kill=True,
+                    extra=["--integrity", "1.0"])
+        survivors = _fits(out)
+        assert 0 < len(survivors) < N_OBS
+        proc = _run_export(out, plan_file=plan_file, resume_mode="verify",
+                           extra=["--integrity", "1.0", "--scrub"])
+        rep = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rep["scrub"]["bad"] == []
+        got = _fits(out)
+        ref = _fits(clean_dir)
+        assert [os.path.basename(p) for p in got] == \
+               [os.path.basename(p) for p in ref]
+        for a, b in zip(ref, got):
+            assert open(a, "rb").read() == open(b, "rb").read(), b
+        # the first run's journal recorded the healed audit event
+        events = [json.loads(line) for line in
+                  open(os.path.join(out, "run_journal.jsonl"))]
+        integ = [e for e in events if e["e"] == "integrity"]
+        assert any(e["kind"] == "audit" and e["healed"] for e in integ)
+
+
 class TestNaNQuarantine:
     def test_poisoned_obs_quarantined_retried_recovered(self, ens,
                                                         tmp_path):

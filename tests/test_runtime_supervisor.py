@@ -95,6 +95,179 @@ class TestRetryPolicy:
         assert seen == [(0, 2.0), (1, 4.0)]
 
 
+class TestRetryClassification:
+    """Transient-vs-permanent error classification (PR 14 satellite):
+    a permanent error fails fast with its evidence, never burning the
+    backoff budget."""
+
+    def test_permanent_error_raises_immediately_without_backoff(self):
+        from psrsigsim_tpu.runtime import IntegrityError
+
+        calls, sleeps = [], []
+
+        def fn():
+            calls.append(1)
+            raise IntegrityError("device disagreed twice",
+                                 evidence={"start": 8})
+
+        policy = RetryPolicy(max_attempts=5, base_delay=1.0,
+                             permanent_on=(IntegrityError,))
+        with pytest.raises(IntegrityError) as err:
+            call_with_retry(fn, policy, sleep=sleeps.append)
+        assert len(calls) == 1 and sleeps == []   # no retry, no backoff
+        assert err.value.evidence == {"start": 8}
+        assert "start" in str(err.value)
+
+    def test_transient_errors_still_retry_under_same_policy(self):
+        from psrsigsim_tpu.runtime import IntegrityError
+
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("flaky writer")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5, base_delay=0.0,
+                             permanent_on=(IntegrityError,))
+        assert call_with_retry(fn, policy, sleep=lambda _s: None) == "ok"
+        assert len(calls) == 3
+
+    def test_policy_classifies(self):
+        from psrsigsim_tpu.runtime import IntegrityError
+
+        p = RetryPolicy(permanent_on=(IntegrityError,))
+        assert p.is_permanent(IntegrityError("x"))
+        assert not p.is_permanent(OSError("x"))
+        assert not RetryPolicy().is_permanent(IntegrityError("x"))
+
+
+class TestSharedJournalLoader:
+    """THE one torn-tail rule (PR 14 satellite): every journal consumer
+    — the run supervisor, the chunked-run loaders, the serving cache —
+    replays through runtime.supervisor.load_journal_records."""
+
+    def test_torn_tail_skipped_and_truncated(self, tmp_path):
+        from psrsigsim_tpu.runtime import load_journal_records
+
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as f:
+            f.write('{"e": "chunk", "start": 0}\n')
+            f.write('{"e": "chunk", "start": 8}\n')
+            f.write('{"e": "chunk", "sta')   # torn mid-write
+        recs, valid_end = load_journal_records(path)
+        assert [r["start"] for r in recs] == [0, 8]
+        # truncated: appending later records cannot weld onto the torn
+        # fragment
+        assert os.path.getsize(path) == valid_end
+        with open(path, "a") as f:
+            f.write('{"e": "chunk", "start": 16}\n')
+        recs2, _ = load_journal_records(path)
+        assert [r["start"] for r in recs2] == [0, 8, 16]
+
+    def test_garbage_line_stops_replay(self, tmp_path):
+        from psrsigsim_tpu.runtime import load_journal_records
+
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as f:
+            f.write('{"e": "chunk", "start": 0}\n')
+            f.write('not json at all\n')
+            f.write('{"e": "chunk", "start": 8}\n')
+        recs, _ = load_journal_records(path)
+        assert [r["start"] for r in recs] == [0]
+
+    def test_missing_journal_is_empty(self, tmp_path):
+        from psrsigsim_tpu.runtime import load_journal_records
+
+        assert load_journal_records(str(tmp_path / "none")) == ([], 0)
+
+    def test_chunk_view_filters_and_keys(self, tmp_path):
+        from psrsigsim_tpu.runtime import load_chunk_journal
+
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w") as f:
+            f.write('{"e": "chunk", "start": 0, "sha": "a"}\n')
+            f.write('{"e": "integrity", "start": 0, "kind": "audit"}\n')
+            f.write('{"e": "chunk", "start": 8, "sha": "b"}\n')
+        done = load_chunk_journal(path)
+        assert sorted(done) == [0, 8] and done[8]["sha"] == "b"
+
+    def test_cache_open_uses_shared_rule(self, tmp_path):
+        """The serving cache's open-time replay rides the same loader:
+        a torn tail is truncated under the flock and the index holds
+        exactly the complete records."""
+        from psrsigsim_tpu.serve.cache import ResultCache
+
+        cache = ResultCache(str(tmp_path / "c"), hot_tail_check_s=0.0,
+                            scrub_interval_s=0)
+        rec = cache.put("aa11", np.arange(4, dtype=np.float32))
+        cache.close()
+        jpath = os.path.join(str(tmp_path / "c"), "cache_journal.jsonl")
+        with open(jpath, "a") as f:
+            f.write('{"e": "put", "hash": "torn')
+        reopened = ResultCache(str(tmp_path / "c"), hot_tail_check_s=0.0,
+                               scrub_interval_s=0)
+        assert len(reopened) == 1 and "aa11" in reopened
+        assert not open(jpath).read().endswith("torn")
+        assert reopened._index["aa11"]["sha256"] == rec["sha256"]
+        reopened.close()
+
+
+class TestDigestLattice:
+    """The checksum fold's host/device twins must agree bit for bit —
+    the zero-false-positive foundation of the whole integrity layer."""
+
+    def test_host_device_parity_int16_float32_fields(self):
+        import jax.numpy as jnp
+
+        from psrsigsim_tpu.runtime import integrity as it
+
+        rng = np.random.default_rng(7)
+        a16 = rng.integers(-32768, 32767, size=(4, 3, 10), dtype=np.int16)
+        f32 = rng.normal(size=(5, 17)).astype(np.float32)
+        assert np.array_equal(
+            it.digest_rows(a16, salt=3),
+            np.asarray(it._digest_program(
+                "t3", lambda x: it._digest_rows_traced(x, 3))(
+                    jnp.asarray(a16))))
+        assert np.array_equal(
+            it.digest_rows(f32),
+            np.asarray(it.device_digest_rows(jnp.asarray(f32))))
+        fields = [f32, rng.integers(0, 2, size=(5, 3)).astype(np.uint8)]
+        assert np.array_equal(
+            it.fields_digest_rows_host(fields),
+            np.asarray(it.device_fields_digest_rows(
+                [jnp.asarray(x) for x in fields])))
+
+    def test_single_bit_flip_changes_digest(self):
+        from psrsigsim_tpu.runtime import integrity as it
+
+        a = np.arange(64, dtype=np.int16).reshape(2, 32)
+        d0 = it.digest_rows(a)
+        b = a.copy()
+        b[1, 17] ^= 1
+        d1 = it.digest_rows(b)
+        assert d0[0] == d1[0] and d0[1] != d1[1]
+        # positional: swapping two words is not invisible
+        c = a.copy()
+        c[0, 3], c[0, 4] = a[0, 4], a[0, 3]
+        assert it.digest_rows(c)[0] != d0[0]
+
+    def test_audit_sampling_deterministic_and_proportional(self):
+        from psrsigsim_tpu.runtime.integrity import audit_selected
+
+        picks = [audit_selected("fp", i, 0.05) for i in range(4000)]
+        assert picks == [audit_selected("fp", i, 0.05)
+                         for i in range(4000)]
+        assert 100 < sum(picks) < 320     # ~5%, generous band
+        assert audit_selected("fp", 1, 1.0)
+        assert not audit_selected("fp", 1, 0.0)
+        # fingerprint-seeded: different runs sample different chunks
+        assert [audit_selected("fp2", i, 0.05) for i in range(4000)] \
+            != picks
+
+
 class TestFaultPlan:
     def test_unknown_point_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="unknown fault point"):
