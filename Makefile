@@ -52,10 +52,18 @@
 # dataset and serving producers (export/MC legs run in tier-1); the 5%
 # audit stays under a loose cost bound (bench.py integrity_smoke; the
 # honest cost numbers land in config14_integrity).
+# `make pod-smoke` is the multi-host pod gate: ensemble/MC/dataset/serve
+# results bit-identical across host counts {1,2} on a constant-size
+# global mesh (local jax.distributed CPU cluster), a joining host warms
+# from the shared persistent compilation cache with ZERO new compiles,
+# and a follower SIGKILL'd mid-run aborts the whole program group loudly
+# (POD_PEER_EXIT, never a wedged collective) with a byte-identical
+# resume on relaunch (bench.py pod_smoke; the scaling numbers land in
+# config15_pod).
 
 .PHONY: lint test test-faults bench-export bench-mc serve-smoke \
 	bench-scenarios fleet-smoke elastic-smoke bench-c10k bench-dataset \
-	integrity-smoke
+	integrity-smoke pod-smoke
 
 lint:
 	JAX_PLATFORMS=cpu python -m psrsigsim_tpu.analysis psrsigsim_tpu --trace-check
@@ -92,3 +100,6 @@ bench-dataset:
 
 integrity-smoke:
 	JAX_PLATFORMS=cpu python bench.py --integrity-smoke
+
+pod-smoke:
+	JAX_PLATFORMS=cpu python bench.py --pod-smoke

@@ -2867,6 +2867,150 @@ def integrity_smoke():
             **result, "ok": True}
 
 
+# ---------------------------------------------------------------------------
+# Config 15: pod-scale execution (multi-host meshes, PR 15)
+# ---------------------------------------------------------------------------
+
+
+def _run_pod_runner(extra, timeout=900):
+    """Run tests/pod_runner.py and return its one-line JSON verdict.
+    Pod proofs spawn whole jax.distributed process clusters, so they
+    cannot run inside the (already backend-initialized) bench process."""
+    import subprocess
+
+    runner = os.path.join(REPO, "tests", "pod_runner.py")
+    proc = subprocess.run(
+        [sys.executable, runner, *extra], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=timeout)
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"pod_runner {extra} rc={proc.returncode}: "
+            f"{proc.stderr[-2000:]}")
+    return json.loads(lines[-1])
+
+
+def time_pod(hosts=(1, 2), devices_per_host=None, n_obs=None):
+    """Config 15: the MULTICHIP records made real — per-host and
+    aggregate quantized-ensemble obs/s at host counts {1, 2} with a
+    FIXED devices-per-host (the pod scaling axis: adding hosts adds
+    devices), scaling efficiency, per-family compile counts, and the
+    leader's stage timers.  On one CPU the hosts time-share physical
+    cores, so the local number measures pod-runtime overhead (channel
+    fetch + lockstep), not device scaling — on a real v4 slice each
+    host owns its chips and the same harness measures the 100x path."""
+    if devices_per_host is None:
+        devices_per_host = int(os.environ.get(
+            "PSS_BENCH_POD_DEVICES_PER_HOST", "4"))
+    if n_obs is None:
+        n_obs = int(os.environ.get("PSS_BENCH_POD_OBS", "64"))
+    verdict = _run_pod_runner(
+        ["--mode", "bench", "--hosts", ",".join(str(h) for h in hosts),
+         "--devices-per-host", str(devices_per_host),
+         "--bench-obs", str(n_obs)])
+    levels = verdict["levels"]
+    top = str(max(int(h) for h in levels))
+    return {
+        "metric": "pod_bench",
+        "hosts": sorted(int(h) for h in levels),
+        "devices_per_host": devices_per_host,
+        "n_obs": n_obs,
+        "levels": levels,
+        "pod_aggregate_obs_per_sec":
+            levels[top]["aggregate_obs_per_sec"],
+        "pod_per_host_obs_per_sec": levels[top]["per_host_obs_per_sec"],
+        "pod_scaling_efficiency": levels[top]["scaling_efficiency"],
+        "pod_compile_counts": levels[top]["program_builds"],
+        "stage_timers": levels[top].get("stage_timers", {}),
+        "ok": True,
+    }
+
+
+def pod_smoke():
+    """Quick pod gate (``make pod-smoke``):
+
+    (a) HOST-COUNT BIT-IDENTITY — ensemble packed/chunked, MC metrics +
+        histograms, dataset records, and served profiles hash identical
+        at host counts {1, 2} over a constant 8-device global mesh (the
+        pod analogue of the chunk-size invariance; {1,2,4} is pinned by
+        the slow tier-1 test).
+    (b) WARM JOIN — a second, fresh-process 2-host pod over an already-
+        populated persistent compilation cache adds ZERO new cache
+        entries for the built (geometry, width, mesh) keys and returns
+        identical hashes.
+    (c) DEGRADED POD — a follower SIGKILL'd mid-export surfaces as a
+        loud whole-group abort (leader exits POD_PEER_EXIT — never a
+        wedged collective), and a clean full-group relaunch resumes the
+        journaled export byte-identical to an uninterrupted solo run.
+    """
+    import glob
+    import shutil
+    import subprocess
+    import tempfile
+
+    from psrsigsim_tpu.runtime.dist import POD_PEER_EXIT
+
+    ident = _run_pod_runner(
+        ["--mode", "identity", "--hosts", "1,2",
+         "--families", "ensemble,mc,dataset,serve"])
+    assert ident["ok"] and ident["mismatches"] == {}, (
+        f"host-count bit-identity FAILED: {ident}")               # (a)
+
+    warm = _run_pod_runner(["--mode", "warm", "--warm-hosts", "2",
+                            "--families", "ensemble,mc"])
+    assert warm["ok"], f"warm-join gate FAILED: {warm}"
+    assert warm["new_entries_on_join"] == 0, warm                 # (b)
+    assert warm["hashes_equal"], warm
+
+    # (c) the degraded-pod restart proof (fault_runner pod mode) — the
+    # group spawner is SHARED with tests/test_pod.py (one place stages
+    # the pod env/flags, so bench and the tier-1 proofs cannot drift
+    # onto different topologies)
+    base = tempfile.mkdtemp(prefix="pss_pod_smoke_")
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from pod_runner import spawn_fault_group
+
+    def _group(out_dir, n_hosts, follower_plan=None, extra=()):
+        return [(rc, err) for rc, _, err in spawn_fault_group(
+            out_dir, n_hosts, 12, 4, follower_plan=follower_plan,
+            extra=extra)]
+
+    def _bytes(out_dir):
+        return {os.path.basename(p): open(p, "rb").read()
+                for p in sorted(glob.glob(os.path.join(out_dir,
+                                                       "*.fits")))}
+
+    try:
+        solo = os.path.join(base, "solo")
+        (rc, err), = _group(solo, 1)
+        assert rc == 0, err[-2000:]
+        want = _bytes(solo)
+
+        plan = os.path.join(base, "podkill.json")
+        with open(plan, "w") as f:
+            json.dump({"scratch_dir": os.path.join(base, "scratch"),
+                       "spec": {"pod.kill": {"after_chunks": 1}}}, f)
+        out = os.path.join(base, "pod")
+        # depth 0: strict per-chunk rendezvous — the kill deterministically
+        # leaves a mid-run state (see tests/test_pod.py TestPodKill)
+        (lead_rc, lead_err), (fol_rc, _) = _group(
+            out, 2, follower_plan=plan, extra=("--pipeline-depth", "0"))
+        assert fol_rc in (-9, 137), (fol_rc, lead_rc)
+        assert lead_rc == POD_PEER_EXIT, (lead_rc, lead_err[-2000:])
+        results = _group(out, 2)
+        for rc, err in results:
+            assert rc == 0, err[-2000:]
+        assert _bytes(out) == want, "degraded-pod resume NOT byte-identical"
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    return {"metric": "pod_smoke", "identity": ident, "warm": warm,
+            "degraded_pod": {"follower_rc": fol_rc, "leader_rc": lead_rc,
+                             "resume_byte_identical": True},
+            "ok": True}
+
+
 _REAL_STDOUT = sys.stdout
 
 # ---------------------------------------------------------------------------
@@ -2918,6 +3062,8 @@ _COMPACT_FIELDS = (
     ("checksum_overhead", "ichk", 3),
     ("audit5_cost", "iaud5", 3),
     ("scrub_artifacts_per_sec", "iscrub_s", 0),
+    ("pod_aggregate_obs_per_sec", "pod_s", 1),
+    ("pod_scaling_efficiency", "peff", 2),
     ("bottleneck_stage", "bn", None),
     ("slope_ok", "ok", None),
     ("sync_warn", "warn", None),
@@ -3063,6 +3209,14 @@ def main():
         # loose audit-cost bound
         with contextlib.redirect_stdout(sys.stderr):
             result = integrity_smoke()
+        print(json.dumps(result), file=_REAL_STDOUT, flush=True)
+        return
+    if "--pod-smoke" in sys.argv[1:]:
+        # `make pod-smoke`: host-count {1,2} bit-identity + zero-
+        # recompile warm join + degraded-pod loud-abort/byte-identical-
+        # resume gates (all in spawned pod clusters; see pod_smoke)
+        with contextlib.redirect_stdout(sys.stderr):
+            result = pod_smoke()
         print(json.dumps(result), file=_REAL_STDOUT, flush=True)
         return
     if "--scenario-smoke" in sys.argv[1:]:
@@ -3293,6 +3447,18 @@ def _main():
         f"{integ['records_per_sec_off']:.1f} records/s; scrub "
         f"{integ['scrub_artifacts_per_sec']:.0f} artifacts/s "
         f"({integ['scrub_mb_per_sec']:.0f} MB/s)")
+    _checkpoint(detail)
+
+    # --- config 15: pod-scale execution (multi-host meshes) -------------
+    pod = time_pod()
+    detail["config15_pod"] = pod
+    _top = str(max(pod["hosts"]))
+    log(f"config15_pod: hosts {pod['hosts']} x{pod['devices_per_host']} "
+        f"devices/host -> aggregate "
+        f"{pod['pod_aggregate_obs_per_sec']:.1f} obs/s at {_top} hosts "
+        f"(per-host {pod['pod_per_host_obs_per_sec']}, scaling "
+        f"efficiency {pod['pod_scaling_efficiency']:.2f}, compiles "
+        f"{pod['pod_compile_counts']})")
     _checkpoint(detail)
 
     # --- end-to-end export: device -> host -> PSRFITS files -------------

@@ -186,43 +186,64 @@ class DatasetFactory:
         names = [n for n, _, _ in layout]
         width = sampler.chunk_width(chunk_size)
 
+        from ..runtime.dist import is_leader, is_pod
         from ..runtime.integrity import resolve_integrity
 
         checker = resolve_integrity(integrity, fingerprint=self.fingerprint,
                                     faults=faults)
+        if checker is not None and is_pod():
+            # audit/heal re-dispatches would break the pod's collective
+            # lockstep (the MC engine's rule): refuse loudly, don't hang
+            raise RuntimeError(
+                "integrity checking is not supported on a pod mesh yet; "
+                "run integrity-armed corpora single-host")
+        # pod: every process computes every chunk (the fetch replicates),
+        # ONE owns the shards/journal/manifest; followers read the same
+        # journal so skip decisions stay in lockstep
+        lead = is_leader()
 
         os.makedirs(out_dir, exist_ok=True)
-        self._check_manifest(out_dir, resume)
+        if lead:
+            self._check_manifest(out_dir, resume)
         journal_path = os.path.join(out_dir, _JOURNAL_NAME)
         cursor_path = os.path.join(out_dir, _CURSOR_NAME)
         if not resume:
-            # the overwrite path must remove EVERY previous corpus byte,
-            # not just the journal: a prior corpus with more records or
-            # more shards would otherwise leave stale tail bytes inside
-            # (and stale shard/index files beside) the new one, breaking
-            # the equal-fingerprints-mean-byte-identical-corpora contract
-            import glob as _glob
-
-            stale = [journal_path, cursor_path]
-            stale += _glob.glob(os.path.join(out_dir, "shard-*.records"))
-            stale += _glob.glob(os.path.join(out_dir,
-                                             "shard-*.index.json"))
-            for p in stale:
-                try:
-                    os.unlink(p)
-                except FileNotFoundError:
-                    pass
+            # pod followers must NOT read the stale journal the leader
+            # is concurrently wiping (their skip decisions would diverge
+            # from the leader's empty `done` — lockstep breaks); only
+            # the leader unlinks, everyone starts from nothing
             done = {}
+            if lead:
+                # the overwrite path must remove EVERY previous corpus
+                # byte, not just the journal: a prior corpus with more
+                # records or more shards would otherwise leave stale
+                # tail bytes inside (and stale shard/index files beside)
+                # the new one, breaking the equal-fingerprints-mean-
+                # byte-identical-corpora contract
+                import glob as _glob
+
+                stale = [journal_path, cursor_path]
+                stale += _glob.glob(os.path.join(out_dir,
+                                                 "shard-*.records"))
+                stale += _glob.glob(os.path.join(out_dir,
+                                                 "shard-*.index.json"))
+                for p in stale:
+                    try:
+                        os.unlink(p)
+                    except FileNotFoundError:
+                        pass
         else:
             done = load_chunk_journal(journal_path)
 
         writer = ShardWriter(out_dir, self.n_records, self.n_shards,
                              layout, RECORD_FORMAT_VERSION)
-        # indexes are a pure function of the spec: write them first (and
-        # on every resume — idempotent, atomic), so even a corpus killed
-        # mid-run has self-describing shards
-        writer.write_indexes(self.fingerprint, self.canonical["seed"])
-        journal_f = open(journal_path, "a")
+        journal_f = None
+        if lead:
+            # indexes are a pure function of the spec: write them first
+            # (and on every resume — idempotent, atomic), so even a
+            # corpus killed mid-run has self-describing shards
+            writer.write_indexes(self.fingerprint, self.canonical["seed"])
+            journal_f = open(journal_path, "a")
 
         commits = 0
         resumed = 0
@@ -258,11 +279,15 @@ class DatasetFactory:
                     + tuple(dev[1:])
                 dev = dev + (device_fields_digest_rows(dev),)
             telemetry.add("dispatch", _time.perf_counter() - t0)
+            telemetry.track_live(dev)
             return dev
 
         def _fetch(dev):
+            from ..runtime.dist import device_get as pod_device_get
+
             t0 = _time.perf_counter()
-            host = jax.device_get(dev)
+            host = pod_device_get(dev)
+            telemetry.untrack_live(dev)
             telemetry.add("fetch", _time.perf_counter() - t0,
                           nbytes=sum(np.asarray(a).nbytes for a in host))
             return host
@@ -348,6 +373,12 @@ class DatasetFactory:
             fsync, THEN the journal line, THEN the atomic cursor — a
             SIGKILL leaves either a committed record or none."""
             nonlocal commits
+            if journal_f is None:
+                # pod follower: the leader owns the durable record;
+                # this process computed the chunk only to stay in
+                # collective lockstep
+                commits += 1
+                return
             t0 = _time.perf_counter()
             touched = set()
             h = hashlib.sha256()
@@ -404,7 +435,12 @@ class DatasetFactory:
                 dig = None
                 if checker is not None:
                     host, dig = _integrity_verify(s0, c0, host)
-                _commit(s0, _encode(s0, c0, host), dig=dig)
+                # pod followers discard the records in _commit (the
+                # leader owns the durable copy) — lockstep needs only
+                # the dispatch/fetch and the commit count, so don't pay
+                # the encode stage for bytes that are thrown away
+                recs = [] if journal_f is None else _encode(s0, c0, host)
+                _commit(s0, recs, dig=dig)
                 _report(c0)
                 if (_stop_after_chunks is not None
                         and commits >= _stop_after_chunks):
@@ -429,7 +465,8 @@ class DatasetFactory:
                 if stopped:
                     return None
         finally:
-            journal_f.close()
+            if journal_f is not None:
+                journal_f.close()
             writer.close()
 
         out = {

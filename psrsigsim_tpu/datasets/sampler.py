@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..mc.priors import parse_prior, sample_priors
 from ..parallel.mesh import CHAN_AXIS, OBS_AXIS, make_mesh
+from ..runtime.dist import device_get as pod_device_get, put_sharded
 from ..simulate.pipeline import single_pipeline
 from ..scenarios.registry import energy_truth, rfi_truth_mask
 from ..utils.rng import stage_key
@@ -102,11 +103,11 @@ class RecordSampler:
                         and "single_pulse" in self.stack.names())
 
         chan_sh = NamedSharding(self.mesh, P(CHAN_AXIS))
-        self._profiles_dev = jax.device_put(
+        self._profiles_dev = put_sharded(
             self._profiles_np, NamedSharding(self.mesh, P(CHAN_AXIS, None)))
-        self._freqs_dev = jax.device_put(
+        self._freqs_dev = put_sharded(
             np.asarray(self.cfg.meta.dat_freq_mhz(), np.float32), chan_sh)
-        self._chan_ids_dev = jax.device_put(np.arange(nchan), chan_sh)
+        self._chan_ids_dev = put_sharded(np.arange(nchan), chan_sh)
         self._obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
         self._programs = {}  # chunk width -> jitted sharded program
 
@@ -225,6 +226,11 @@ class RecordSampler:
         # replicated, but the rep checker cannot prove it through the
         # vmapped draws (the study engine's situation exactly)
         def _build():
+            from ..runtime.programs import donation_enabled
+
+            # donate the per-chunk keys/indices (they die with the
+            # dispatch); the staged profile/frequency constants are
+            # reused and never donated.  Byte-invariant (test_pod.py).
             return jax.jit(shard_map(
                 _local,
                 mesh=mesh,
@@ -232,7 +238,7 @@ class RecordSampler:
                           P(CHAN_AXIS), P(CHAN_AXIS)),
                 out_specs=self._out_specs(),
                 check_rep=False,
-            ))
+            ), donate_argnums=(0, 1) if donation_enabled() else ())
 
         from ..runtime.programs import global_registry, trace_env_key
 
@@ -264,8 +270,8 @@ class RecordSampler:
         idx_j = jnp.asarray(idx, jnp.int32)
         keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx_j)
         return self.program(width, audit=audit)(
-            jax.device_put(keys, self._obs_sharding),
-            jax.device_put(idx_j, self._obs_sharding),
+            put_sharded(keys, self._obs_sharding),
+            put_sharded(idx_j, self._obs_sharding),
             self._profiles_dev, self._freqs_dev, self._chan_ids_dev)
 
     # -- host-side conveniences ---------------------------------------------
@@ -275,7 +281,7 @@ class RecordSampler:
         add-an-effect tutorial): the same program path as the factory,
         width = one obs-shard round."""
         width = self.chunk_width(1)
-        out = jax.device_get(self.dispatch(int(index), width))
+        out = pod_device_get(self.dispatch(int(index), width))
         return {name: np.asarray(a[0])
                 for (name, _, _), a in zip(self.field_layout(), out)}
 

@@ -955,6 +955,114 @@ def _check_manifest(out_dir, fp, resume):
     _write_manifest(out_dir, merged)
 
 
+def _export_paths(out_dir, n_obs, obs_per_file, packer):
+    """Output file names for one export — THE naming scheme, shared by
+    the leader (:func:`export_ensemble_psrfits`) and the pod follower
+    mirror (:func:`pod_export_follower`), which must agree on resume
+    skip decisions file for file."""
+    width = max(5, len(str(n_obs - 1)))
+    if obs_per_file == 1:
+        return [os.path.join(out_dir, f"obs_{i:0{width}d}.fits")
+                for i in range(n_obs)]
+    paths = []
+    for g in range(packer.n_groups):
+        first, end = packer.group_span(g)
+        paths.append(os.path.join(
+            out_dir, f"obs_{first:0{width}d}-{end - 1:0{width}d}.fits"))
+    return paths
+
+
+def _chunk_skip_predicate(packer, paths, file_done):
+    """The chunk-level resume predicate, derived from ONE group-level
+    definition of "this group's file is done": a chunk skips only when
+    every file any of its observations feeds is done.  Returns
+    ``(skip, skip_group)`` — shared by the leader's export loop and the
+    pod follower mirror, whose skip decisions must be identical by
+    construction (a divergent copy is the documented lockstep-corruption
+    failure mode)."""
+    def skip_group(g):
+        return file_done(paths[g])
+
+    def skip(start, count):
+        g_lo = packer.group_of(start)
+        g_hi = packer.group_of(start + count - 1)
+        return all(skip_group(g) for g in range(g_lo, g_hi + 1))
+
+    return skip, skip_group
+
+
+def pod_export_follower(ens, n_obs, out_dir, seed=0, dms=None,
+                        noise_norms=None, chunk_size=256, resume=True,
+                        verify=False, obs_per_file=1, pipeline_depth=2,
+                        scenario_params=None, progress=None):
+    """A pod FOLLOWER's half of a supervised export: drive the SAME
+    chunk sequence as the leader (same skip decisions, same program
+    dispatches, same fetches) so every collective rendezvouses, while
+    the leader alone owns files, journal, and manifest.
+
+    Lockstep is by construction, not coordination: both sides read the
+    same out_dir state before dispatching anything (existence under
+    plain resume; journal/manifest sha under ``verify`` — read with
+    ``truncate=False``, a live peer appender owns the file), and every
+    later decision is a pure function of data every process fetched
+    identically (``device_get`` replicates).  Quarantine would diverge
+    the leader's control flow, so a non-finite observation raises here
+    exactly as the leader's pod guard does.
+
+    Returns the (leader-owned) output paths this process mirrored.
+    """
+    from ..runtime.dist import is_pod
+
+    if not is_pod():
+        raise RuntimeError("pod_export_follower requires an initialized "
+                           "pod (runtime.dist.init_pod)")
+    from ..runtime.supervisor import file_done_check, load_resume_hashes
+
+    dms_np = None if dms is None else np.asarray(dms, np.float64)
+    packer = _GroupPacker(n_obs, obs_per_file, dms=dms_np)
+    paths = _export_paths(out_dir, n_obs, obs_per_file, packer)
+
+    # the SAME hash source and per-file predicate the leader's
+    # supervisor uses (truncate=False: the live leader owns the
+    # journal) — skip decisions are identical by construction
+    hashes = {}
+    if verify:
+        hashes, _ = load_resume_hashes(out_dir, truncate=False)
+    verified = set()
+
+    def file_done(path):
+        return file_done_check(path, hashes, verify, verified)
+
+    skip = None
+    if resume:
+        skip, _ = _chunk_skip_predicate(packer, paths, file_done)
+
+    want_rfi = getattr(ens, "_has_rfi", False)
+    bad_chunks = []
+    for start, block in ens.iter_chunks(
+        n_obs, chunk_size=chunk_size, seed=seed, dms=dms,
+        noise_norms=noise_norms, quantized=True, progress=progress,
+        skip_chunk=skip, byte_order="big", finite_mask=True,
+        rfi_mask=want_rfi, scenario_params=scenario_params,
+        prefetch=max(1, pipeline_depth), fetch_ahead=pipeline_depth,
+    ):
+        finite = np.asarray(block[3])
+        if not finite.all():
+            # the leader quarantines and keeps driving the chunk loop,
+            # raising only AFTER it (its pod guard); raising here
+            # mid-loop would kill this process while the leader still
+            # fetches — a PodPeerLost crash-loop instead of the
+            # diagnostic.  Mirror the full loop, then fail the same way.
+            bad_chunks.append(int(start))
+    if bad_chunks:
+        raise RuntimeError(
+            f"pod export: non-finite observation(s) in chunk(s) "
+            f"{bad_chunks} on a pod mesh (the leader's salted-retry "
+            "quarantine is single-host only; this mirrors its loud "
+            "post-loop failure — fix the inputs or export single-host)")
+    return paths
+
+
 class _GroupPacker:
     """Accumulate per-observation quantized triples into packed file
     groups along the subint axis.
@@ -1164,8 +1272,25 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
     Returns:
         list of the output file paths (length ``ceil(n_obs/obs_per_file)``).
     """
+    from ..runtime.dist import is_leader as _pod_leader, is_pod as _pod
     from ..runtime.telemetry import StageTimers
 
+    if _pod() and not _pod_leader():
+        # one process owns the files/journal/manifest; followers join
+        # the same device programs through the mirror loop instead
+        raise RuntimeError(
+            "pod followers must drive exports with "
+            "psrsigsim_tpu.io.export.pod_export_follower(); only the "
+            "pod leader runs export_ensemble_psrfits")
+    if _pod() and supervisor is None:
+        # the follower mirror fetches the supervised leader's exact
+        # per-chunk leaf set (packed + finite [+ rfi]); an unsupervised
+        # leader would fetch FEWER leaves per chunk and desynchronize
+        # the channel exchange — refuse rather than corrupt
+        raise RuntimeError(
+            "pod exports must be supervised: use "
+            "psrsigsim_tpu.runtime.supervised_export (the follower "
+            "mirror assumes the supervised leader's fetch sequence)")
     pipeline_depth = int(pipeline_depth)
     if pipeline_depth < 0:
         raise ValueError("pipeline_depth must be >= 0")
@@ -1225,16 +1350,7 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
 
     dms_np = None if dms is None else np.asarray(dms, np.float64)
     packer = _GroupPacker(n_obs, obs_per_file, dms=dms_np)
-    width = max(5, len(str(n_obs - 1)))
-    if obs_per_file == 1:
-        paths = [os.path.join(out_dir, f"obs_{i:0{width}d}.fits")
-                 for i in range(n_obs)]
-    else:
-        paths = []
-        for g in range(packer.n_groups):
-            first, end = packer.group_span(g)
-            paths.append(os.path.join(
-                out_dir, f"obs_{first:0{width}d}-{end - 1:0{width}d}.fits"))
+    paths = _export_paths(out_dir, n_obs, obs_per_file, packer)
 
     # a finished file is the unit of resume; files are written to a temp
     # name and renamed on success, so existence implies completeness and
@@ -1255,14 +1371,9 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
         # skip_group is THE definition of "this group's file is done";
         # it feeds the packer so finished straddling groups are never
         # buffered (ADVICE r5 #2), and the chunk-level predicate derives
-        # from it so a change to resume semantics touches one place
-        def skip_group(g):
-            return file_done(paths[g])
-
-        def skip(start, count):
-            g_lo = packer.group_of(start)
-            g_hi = packer.group_of(start + count - 1)
-            return all(skip_group(g) for g in range(g_lo, g_hi + 1))
+        # from it (shared with the pod follower mirror) so a change to
+        # resume semantics touches one place
+        skip, skip_group = _chunk_skip_predicate(packer, paths, file_done)
 
     # the writer state carries a shallow COPY of the ensemble's signal
     # shell: packed groups resize its subint geometry and per-obs DMs
@@ -1462,6 +1573,12 @@ def export_ensemble_psrfits(ens, n_obs, out_dir, template, pulsar,
             if pool.degraded and supervisor is not None:
                 supervisor.note_degraded()
 
+    if supervisor is not None and bad_obs and _pod():
+        raise RuntimeError(
+            f"pod export: {len(bad_obs)} observation(s) hit the NaN "
+            "quarantine; the salted-retry pass re-dispatches on the "
+            "leader alone, which would desynchronize the pod — fix the "
+            "inputs or export single-host")
     if supervisor is not None and bad_obs:
         _retry_quarantined(ens, supervisor, state, packer, paths, bad_obs,
                            n_obs, seed, dms, noise_norms, obs_per_file,

@@ -38,6 +38,7 @@ point).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import json
 import os
@@ -51,6 +52,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.stats import fixed_histogram
 from ..ops.toa import fftfit_combine, fftfit_shift
 from ..parallel.mesh import CHAN_AXIS, OBS_AXIS, make_mesh
+from ..runtime.dist import (device_get as pod_device_get, is_leader,
+                            is_pod, put_sharded)
 from ..scenarios.registry import (apply_additive_effects,
                                   apply_pulse_effects,
                                   scenario_knobs as _scenario_knobs,
@@ -259,10 +262,10 @@ class MonteCarloStudy:
         self._tau_ref_mhz = float(cfg.meta.fcent_mhz)
         freqs = np.asarray(cfg.meta.dat_freq_mhz(), np.float32)
         chan_sh = NamedSharding(self.mesh, P(CHAN_AXIS))
-        self._profiles_dev = jax.device_put(
+        self._profiles_dev = put_sharded(
             self._profiles_np, NamedSharding(self.mesh, P(CHAN_AXIS, None)))
-        self._freqs_dev = jax.device_put(freqs, chan_sh)
-        self._chan_ids_dev = jax.device_put(np.arange(nchan), chan_sh)
+        self._freqs_dev = put_sharded(freqs, chan_sh)
+        self._chan_ids_dev = put_sharded(np.arange(nchan), chan_sh)
         self._obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
         self._programs = {}   # chunk width -> jitted chunk program
         self._param_fn = None  # jitted sampled-params program (lazy)
@@ -468,7 +471,16 @@ class MonteCarloStudy:
         )
 
         def _build():
-            @jax.jit
+            from ..runtime.programs import donation_enabled
+
+            # donate the per-chunk keys/indices (the chunked-hot-loop
+            # donation satellite): both die with the dispatch; the
+            # staged profiles/freqs/chan_ids are reused and never
+            # donated.  Values are donation-invariant by construction
+            # (pinned by tests/test_pod.py).
+            _donate = (0, 1) if donation_enabled() else ()
+
+            @functools.partial(jax.jit, donate_argnums=_donate)
             def chunk_program(keys, idxs, count, profiles, freqs, chan_ids):
                 metrics = sharded(keys, idxs, profiles, freqs, chan_ids)
                 valid = jnp.arange(width) < count   # padded tail rows
@@ -485,7 +497,59 @@ class MonteCarloStudy:
 
             return chunk_program
 
+        def _build_pod():
+            # pod variant: the reduction happens INSIDE shard_map — each
+            # shard histograms its own rows and the host sums the
+            # integer partials (exact, order-free — the same merge rule
+            # the host already applies across CHUNKS).  The solo build
+            # reduces at the jit level instead, which GSPMD lowers to
+            # in-program collectives — collectives that would interleave
+            # with the fetch-time replication all-gathers across the
+            # dispatch-ahead window and corrupt the gloo streams.  A pod
+            # chunk program carries NO collectives at all; the only
+            # cross-host traffic is the ordered fetch.
+            from ..runtime.programs import donation_enabled
+
+            _donate = (0, 1) if donation_enabled() else ()
+            n_shards = mesh.shape[OBS_AXIS]
+            w_loc = width // n_shards
+
+            def _local_reduced(keys, idxs, count, profiles, freqs,
+                               chan_ids):
+                metrics = jax.vmap(
+                    lambda k, i: ctx._trial_metrics(k, i, profiles, freqs,
+                                                    chan_ids)
+                )(keys, idxs)
+                shard = jax.lax.axis_index(OBS_AXIS)
+                rows = shard * w_loc + jnp.arange(w_loc)
+                valid = rows < count
+                w = valid.astype(jnp.int32)
+                cols = metrics.T
+                hist = jax.vmap(
+                    lambda c, lo, hi: fixed_histogram(c, lo, hi, nbins,
+                                                      weights=w)
+                )(cols, los, his)
+                inf = jnp.float32(jnp.inf)
+                mn = jnp.min(jnp.where(valid[None, :], cols, inf), axis=1)
+                mx = jnp.max(jnp.where(valid[None, :], cols, -inf),
+                             axis=1)
+                return (metrics, hist[None], mn[None], mx[None])
+
+            return jax.jit(shard_map(
+                _local_reduced,
+                mesh=mesh,
+                in_specs=(P(OBS_AXIS), P(OBS_AXIS), P(),
+                          P(CHAN_AXIS, None), P(CHAN_AXIS), P(CHAN_AXIS)),
+                out_specs=(P(OBS_AXIS, None), P(OBS_AXIS, None, None),
+                           P(OBS_AXIS, None), P(OBS_AXIS, None)),
+                check_rep=False,
+            ), donate_argnums=_donate)
+
+        from ..runtime.dist import is_pod
         from ..runtime.programs import global_registry, trace_env_key
+
+        if is_pod():
+            _build = _build_pod
 
         prog = global_registry().get_or_build(
             ("mc_trial_audit" if audit else "mc_trial",
@@ -504,8 +568,8 @@ class MonteCarloStudy:
         root = jax.random.key(self.seed)
         idx_j = jnp.asarray(idx, jnp.int32)
         keys = jax.vmap(lambda i: stage_key(root, "user", i))(idx_j)
-        return (jax.device_put(keys, self._obs_sharding),
-                jax.device_put(idx_j, self._obs_sharding))
+        return (put_sharded(keys, self._obs_sharding),
+                put_sharded(idx_j, self._obs_sharding))
 
     # -- fingerprint / manifest -------------------------------------------
 
@@ -662,6 +726,20 @@ class MonteCarloStudy:
                 json.dumps(self.fingerprint(n_trials),
                            sort_keys=True).encode()).hexdigest(),
             faults=faults)
+        if checker is not None and is_pod():
+            # the audit/heal paths re-dispatch programs on the detecting
+            # process alone, which would desynchronize the pod's
+            # collective lockstep: refuse loudly instead of hanging
+            raise RuntimeError(
+                "integrity checking is not supported on a pod mesh yet "
+                "(duplicate-execution audits break host lockstep); run "
+                "integrity-armed sweeps single-host")
+        # under a pod every process computes the FULL result (the fetch
+        # replicates), but exactly one owns the durable side effects:
+        # manifest, journal, raw rows, cursor, artifact.  Followers read
+        # the same journal for resume-skip decisions — identical inputs,
+        # identical branches, which is what keeps the pod in lockstep.
+        lead = is_leader()
 
         matrix = np.empty((n_trials, M), np.float32)
         hist_tot = np.zeros((M, self.hist_bins), np.int64)
@@ -672,20 +750,28 @@ class MonteCarloStudy:
         done = {}
         if out_dir is not None:
             os.makedirs(out_dir, exist_ok=True)
-            self._check_manifest(out_dir, self.fingerprint(n_trials), resume)
+            if lead:
+                self._check_manifest(out_dir, self.fingerprint(n_trials),
+                                     resume)
             journal_path = os.path.join(out_dir, _JOURNAL_NAME)
             cursor_path = os.path.join(out_dir, _CURSOR_NAME)
             raw_path = os.path.join(out_dir, _TRIALS_RAW)
             if not resume:
-                for p in (journal_path, cursor_path, raw_path):
-                    try:
-                        os.unlink(p)
-                    except FileNotFoundError:
-                        pass
+                if lead:
+                    for p in (journal_path, cursor_path, raw_path):
+                        try:
+                            os.unlink(p)
+                        except FileNotFoundError:
+                            pass
             else:
                 done = _load_journal(journal_path)
-            raw_fd = os.open(raw_path, os.O_RDWR | os.O_CREAT, 0o644)
-            journal_f = open(journal_path, "a")
+            if lead:
+                raw_fd = os.open(raw_path, os.O_RDWR | os.O_CREAT, 0o644)
+                journal_f = open(journal_path, "a")
+            elif resume and os.path.exists(raw_path):
+                # followers verify resumed rows against the same bytes
+                # the leader does — read-only
+                raw_fd = os.open(raw_path, os.O_RDONLY)
 
         commits = 0
         done_trials = 0
@@ -710,7 +796,7 @@ class MonteCarloStudy:
             verified) and its integer accumulators from the journal line;
             returns False when the record does not check out (the chunk
             then recomputes — identical bytes land back in place)."""
-            if int(rec.get("count", -1)) != count:
+            if raw_fd is None or int(rec.get("count", -1)) != count:
                 return False
             nbytes = count * M * 4
             blob = os.pread(raw_fd, nbytes, start * M * 4)
@@ -732,7 +818,13 @@ class MonteCarloStudy:
             the atomic cursor — a SIGKILL leaves either a committed
             record or none, never a half-trusted one."""
             nonlocal commits
-            if raw_fd is None:
+            if journal_f is None:
+                # in-memory run, or a pod follower (the leader owns the
+                # durable record) — still count the chunk: the
+                # _stop_after_chunks condition must fire on the SAME
+                # chunk on every pod process or lockstep breaks (the
+                # dataset factory's follower branch does the same)
+                commits += 1
                 return
             t0 = _time.perf_counter()
             blob = rows.tobytes()
@@ -777,7 +869,12 @@ class MonteCarloStudy:
         def _dispatch(start, count):
             t0 = _time.perf_counter()
             keys, idxs = self._chunk_inputs(start, n_trials, width)
-            out = prog(keys, idxs, jnp.int32(count), self._profiles_dev,
+            cnt = jnp.int32(count)
+            if is_pod():
+                # every input of a pod program must be a global array
+                cnt = put_sharded(np.int32(count),
+                                  NamedSharding(self.mesh, P()))
+            out = prog(keys, idxs, cnt, self._profiles_dev,
                        self._freqs_dev, self._chan_ids_dev)
             if checker is not None:
                 from ..runtime.integrity import device_digest_rows
@@ -790,6 +887,7 @@ class MonteCarloStudy:
                 out = (metrics,) + tuple(out[1:]) \
                     + (device_digest_rows(metrics),)
             telemetry.add("dispatch", _time.perf_counter() - t0)
+            telemetry.track_live(out)
             return out
 
         def _integrity_verify(s0, c0, host):
@@ -859,7 +957,8 @@ class MonteCarloStudy:
 
         def _fetch(dev):
             t0 = _time.perf_counter()
-            host = jax.device_get(dev)
+            host = pod_device_get(dev)
+            telemetry.untrack_live(dev)
             telemetry.add("fetch", _time.perf_counter() - t0,
                           nbytes=sum(np.asarray(a).nbytes for a in host))
             return host
@@ -880,6 +979,13 @@ class MonteCarloStudy:
                         s0, c0, host)
                 else:
                     metrics, hist, mn, mx = host
+                if np.ndim(hist) == 3:
+                    # pod chunk programs return per-shard partials (no
+                    # in-program collectives); merge them exactly the
+                    # way chunks merge — integer sums, min-of-mins
+                    hist = np.asarray(hist).sum(axis=0)
+                    mn = np.asarray(mn).min(axis=0)
+                    mx = np.asarray(mx).max(axis=0)
                 rows = np.ascontiguousarray(metrics[:c0])
                 _merge(s0, c0, rows, hist, mn, mx)
                 _commit(s0, c0, rows, hist, mn, mx, dig=dig)
@@ -923,6 +1029,8 @@ class MonteCarloStudy:
                 man["integrity"] = checker.stats()
                 _atomic_write_json(man_path, man, indent=1)
 
+        if telemetry is not None:
+            telemetry.gauge("pod_leader", int(lead))
         result = StudyResult(
             metric_names=self.metric_names,
             param_names=self.param_names,
@@ -933,7 +1041,7 @@ class MonteCarloStudy:
             spec=self.fingerprint(n_trials),
             telemetry=telemetry.snapshot(),
         )
-        if out_dir is not None:
+        if out_dir is not None and lead:
             result.save(out_dir, keep_trials=keep_trials)
         return result
 

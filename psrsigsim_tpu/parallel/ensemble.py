@@ -20,7 +20,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.quantize import subint_quantize, swap16
-from ..runtime.programs import global_registry, trace_env_key
+from ..runtime.dist import device_get as pod_device_get, put_sharded
+from ..runtime.programs import (donation_enabled, global_registry,
+                                trace_env_key)
 from ..simulate.pipeline import (
     build_fold_config,
     fold_pipeline,
@@ -183,9 +185,19 @@ class FoldEnsemble:
                 f"({n_chan_shards})"
             )
 
-        self._profiles = jnp.asarray(profiles_np)
-        self._freqs = jnp.asarray(self.cfg.meta.dat_freq_mhz(), dtype=jnp.float32)
-        self._chan_ids = jnp.arange(nchan)
+        # staged program constants, placed with their program shardings
+        # ONCE (put_sharded == device_put on a single-process mesh; on a
+        # pod mesh each host places its addressable shards of the same
+        # replicated host value) — the pod-safe spelling of what jit's
+        # first dispatch used to do implicitly
+        chan_sh = NamedSharding(self.mesh, P(CHAN_AXIS))
+        self._profiles_np = np.ascontiguousarray(profiles_np, np.float32)
+        self._profiles = put_sharded(
+            self._profiles_np,
+            NamedSharding(self.mesh, P(CHAN_AXIS, None)))
+        self._freqs = put_sharded(
+            np.asarray(self.cfg.meta.dat_freq_mhz(), np.float32), chan_sh)
+        self._chan_ids = put_sharded(np.arange(nchan), chan_sh)
 
         cfg = self.cfg
         mesh = self.mesh
@@ -317,16 +329,28 @@ class FoldEnsemble:
                 P(OBS_AXIS, CHAN_AXIS),
             ) + ((P(OBS_AXIS, CHAN_AXIS, None),) if has_rfi else ()),
         )
-        # the export path's packed-quantized program family — previously
-        # a per-instance jit cache — resolves through the same registry
+        # buffer donation on the chunked hot loop: the per-chunk
+        # keys/dms/norms (+ scenario matrix) die with the dispatch, so
+        # XLA may alias their HBM into the outputs instead of double-
+        # buffering — values unchanged (pinned donation-on vs -off by
+        # tests/test_pod.py).  Only the packed export family donates:
+        # the float program's inputs are REUSED by the rfi-mask program
+        # on the labeled-float path (iter_chunks), which a donated first
+        # call would have freed.  The flag rides trace_env_key, so
+        # flipping PSS_DONATE resolves fresh registry keys.
+        _donate = (tuple(range(3 + (1 if scen is not None else 0)))
+                   if donation_enabled() else ())
+        self._packed_donate = _donate
         self._run_sharded_quantized_packed = _registry.get_or_build(
             ("ensemble_quantized_packed", "little") + _gkey,
             lambda: jax.jit(
-                shard_map(_local_quantized_packed, **_packed_specs)))
+                shard_map(_local_quantized_packed, **_packed_specs),
+                donate_argnums=_donate))
         self._run_sharded_quantized_packed_be = _registry.get_or_build(
             ("ensemble_quantized_packed", "big") + _gkey,
             lambda: jax.jit(
-                shard_map(_local_quantized_packed_be, **_packed_specs)))
+                shard_map(_local_quantized_packed_be, **_packed_specs),
+                donate_argnums=_donate))
         # duplicate-execution audit support (runtime/integrity.py): the
         # build closures + geometry key are kept so a FRESH compiled
         # instance of the same packed program (same jaxpr -> same HLO ->
@@ -411,8 +435,8 @@ class FoldEnsemble:
                 cols.append(np.asarray(v, np.float32)[idx])
         mat = np.stack(cols, axis=1) if cols else np.zeros(
             (len(idx), 0), np.float32)
-        return jax.device_put(mat,
-                              NamedSharding(self.mesh, P(OBS_AXIS, None)))
+        return put_sharded(mat,
+                           NamedSharding(self.mesh, P(OBS_AXIS, None)))
 
     def _program_args(self, keys, dms, norms, scp):
         """Assemble one program's positional inputs (scenario matrix
@@ -453,6 +477,15 @@ class FoldEnsemble:
         keys, dms, norms, scp, pad = self._prep_inputs(
             n_obs, seed, dms, noise_norms, scenario_params)
         out = self._run_sharded(*self._program_args(keys, dms, norms, scp))
+        from ..runtime.dist import is_pod
+
+        if is_pod():
+            # EAGER ops (slicing included) on multi-process global
+            # arrays are off-limits — each is its own ad-hoc dispatch
+            # the whole pod would have to rendezvous on.  Fetch the full
+            # padded block through the dist layer and trim on host.
+            host = pod_device_get(out)
+            return host[:n_obs] if pad else host
         return out[:n_obs] if pad else out
 
     def run_quantized(self, n_obs, seed=0, dms=None, noise_norms=None,
@@ -501,6 +534,23 @@ class FoldEnsemble:
             n_obs, seed, dms, noise_norms, scenario_params)
         out = self._run_sharded_quantized_packed(
             *self._program_args(keys, dms, norms, scp))
+        from ..runtime.dist import is_pod
+
+        if is_pod():
+            # pod rule: no eager ops on global arrays (see run()).
+            # Fetch the fused buffer and split/trim on HOST — the exact
+            # inverse (_split_packed_chunk), bit-identical by the fused-
+            # transport contract.  Pod callers get host arrays.
+            host = pod_device_get(out)
+            if pad:
+                host = tuple(a[:n_obs] for a in host)
+            data, scl, offs = _split_packed_chunk(host[0], self.cfg.nph)
+            result = (data, scl, offs)
+            if return_finite:
+                result = result + (host[1],)
+            if return_rfi:
+                result = result + (host[-1],)
+            return result
         if pad:
             out = tuple(a[:n_obs] for a in out)
         data, scl, offs = self._split_packed_device(out[0])
@@ -554,9 +604,9 @@ class FoldEnsemble:
             else jnp.asarray(norms_full, jnp.float32)[idx]
         )
         obs_sharding = NamedSharding(self.mesh, P(OBS_AXIS))
-        return (jax.device_put(keys, obs_sharding),
-                jax.device_put(dms, obs_sharding),
-                jax.device_put(norms, obs_sharding))
+        return (put_sharded(keys, obs_sharding),
+                put_sharded(dms, obs_sharding),
+                put_sharded(norms, obs_sharding))
 
     def _audit_quantized_packed(self, byte_order):
         """A FRESH jitted instance of the packed-quantized program (the
@@ -569,9 +619,10 @@ class FoldEnsemble:
         if prog is None:
             fn = self._packed_locals[byte_order]
             specs = self._packed_specs
+            don = self._packed_donate
             prog = global_registry().get_or_build(
                 ("ensemble_quantized_packed_audit", byte_order) + self._gkey,
-                lambda: jax.jit(shard_map(fn, **specs)))
+                lambda: jax.jit(shard_map(fn, **specs), donate_argnums=don))
             self._audit_programs[byte_order] = prog
         return prog
 
@@ -648,6 +699,22 @@ class FoldEnsemble:
                     if byte_order == "big"
                     else self._run_sharded_quantized_packed)
         out = prog(*self._program_args(keys, dms_c, norms_c, scp))
+        from ..runtime.dist import is_pod
+
+        if is_pod():
+            # pod rule: no eager ops on global arrays (see run()) — the
+            # digest variant stays single-host (integrity refuses pods),
+            # so only the plain split/trim needs the host path
+            if return_digest:
+                raise RuntimeError(
+                    "return_digest is single-host only (the integrity "
+                    "layer refuses pod meshes)")
+            host = pod_device_get(out)
+            data, scl, offs = _split_packed_chunk(host[0], self.cfg.nph)
+            result = (data[:n], scl[:n], offs[:n], host[1][:n])
+            if return_rfi:
+                result = result + (host[-1][:n],)
+            return result
         data, scl, offs = self._split_packed_device(out[0])
         finite = out[1]
         result = (data[:n], scl[:n], offs[:n], finite[:n])
@@ -768,6 +835,14 @@ class FoldEnsemble:
         if integrity is not None and not quantized:
             raise ValueError("integrity requires quantized=True (the "
                              "checksum lattice rides the packed transport)")
+        from ..runtime.dist import is_pod as _is_pod
+
+        _pod_mode = _is_pod()
+        if integrity is not None and _pod_mode:
+            raise RuntimeError(
+                "integrity checking is not supported on a pod mesh yet "
+                "(duplicate-execution audits break host lockstep); run "
+                "integrity-armed exports single-host")
         if rfi_mask and not self._has_rfi:
             raise ValueError(
                 "rfi_mask requires an ensemble built with an RFI "
@@ -795,6 +870,13 @@ class FoldEnsemble:
             keys, dms_c, norms_c = self._prep_chunk(idx, seed, dms,
                                                     noise_norms)
             scp = self._prep_scenario(idx, scenario_params)
+            # pod rule: no eager ops on global arrays — the [:count]
+            # trims below are each their own ad-hoc dispatch, so a pod
+            # keeps the full padded chunk on device and _fetch trims the
+            # HOST block instead (byte-identical: the pad rows wrap)
+            def _cut(a):
+                return a if _pod_mode else a[:count]
+
             if quantized:
                 prog = (self._run_sharded_quantized_packed_be
                         if byte_order == "big"
@@ -807,11 +889,11 @@ class FoldEnsemble:
                     # a no-op) — silent device corruption by definition
                     # carries a self-consistent digest
                     packed = integrity.apply_sdc(packed, ident=start)
-                dev = (packed[:count],)
+                dev = (_cut(packed),)
                 if finite_mask:
-                    dev = dev + (outs[1][:count],)
+                    dev = dev + (_cut(outs[1]),)
                 if rfi_mask:
-                    dev = dev + (outs[-1][:count],)
+                    dev = dev + (_cut(outs[-1]),)
                 if integrity is not None:
                     from ..runtime.integrity import \
                         device_packed_digest_rows
@@ -821,28 +903,43 @@ class FoldEnsemble:
             else:
                 args = self._program_args(keys, dms_c, norms_c, scp)
                 out = self._run_sharded(*args)
-                dev = out[:count]
+                dev = _cut(out)
                 if rfi_mask:
                     # float corpora carry ground truth too: the mask
                     # program shares the dispatched inputs and yields
                     # (block, mask) per chunk
-                    dev = (dev, self._run_sharded_rfi_mask(*args)[:count])
+                    dev = (dev, _cut(self._run_sharded_rfi_mask(*args)))
             if timers is not None:
                 timers.add("dispatch", _time.perf_counter() - t0)
             return dev
 
-        def _fetch(dev_block):
+        def _track_dispatch(dev):
+            # live-buffer accounting (the donation satellite's gauge):
+            # dispatched-but-unfetched device bytes, so pod-scale runs
+            # can SEE double-buffering pressure
+            if timers is not None:
+                timers.track_live(dev)
+            return dev
+
+        def _fetch(dev_block, count=None):
             # one batched device->host copy per chunk (device_get on the
             # whole pytree, and for quantized chunks ONE fused buffer plus
-            # the tiny finite/RFI masks), not one transfer per array
+            # the tiny finite/RFI masks), not one transfer per array —
+            # pod meshes fetch through the dist layer (the FIFO channel
+            # exchange), so every host sees the full block, then trims
+            # the padded tail HERE (device trims are eager global-array
+            # ops a pod must not issue)
             t0 = _time.perf_counter()
-            host = jax.device_get(dev_block)
+            host = pod_device_get(dev_block)
+            if _pod_mode and count is not None:
+                host = jax.tree_util.tree_map(lambda a: a[:count], host)
             if quantized:
                 d, s, o = _split_packed_chunk(host[0], nbin)
                 block = (d, s, o) + tuple(host[1:])
             else:
                 block = host
             if timers is not None:
+                timers.untrack_live(dev_block)
                 timers.add("fetch", _time.perf_counter() - t0,
                            nbytes=_block_nbytes(host))
             return block
@@ -867,14 +964,15 @@ class FoldEnsemble:
                 if skip_chunk is not None and skip_chunk(start, count):
                     _report(start + count)
                     continue
-                inflight.append((start, count, _dispatch(start, count)))
+                inflight.append((start, count,
+                                 _track_dispatch(_dispatch(start, count))))
                 if len(inflight) > prefetch:
-                    s0, _, dev = inflight.pop(0)
-                    block = _fetch(dev)
+                    s0, c0, dev = inflight.pop(0)
+                    block = _fetch(dev, c0)
                     _report(s0 + chunk_size)
                     yield s0, block
-            for s0, _, dev in inflight:
-                block = _fetch(dev)
+            for s0, c0, dev in inflight:
+                block = _fetch(dev, c0)
                 _report(s0 + chunk_size)
                 yield s0, block
             return
@@ -900,7 +998,7 @@ class FoldEnsemble:
                 except _queue.Empty:
                     continue
                 try:
-                    res = ("ok", item[0], _fetch(item[2]))
+                    res = ("ok", item[0], _fetch(item[2], item[1]))
                 except BaseException as err:  # noqa: BLE001 — re-raised
                     res = ("error", err, None)  # in the consumer thread
                 while not stop.is_set():
@@ -928,7 +1026,8 @@ class FoldEnsemble:
                     if skip_chunk is not None and skip_chunk(s0, count):
                         _report(s0 + count)
                         continue
-                    in_q.put((s0, count, _dispatch(s0, count)))
+                    in_q.put((s0, count,
+                              _track_dispatch(_dispatch(s0, count))))
                     dispatched += 1
                 if received >= dispatched:
                     continue  # everything so far was skipped
@@ -957,7 +1056,7 @@ class FoldEnsemble:
         """
         from ..mc import MonteCarloStudy
 
-        return MonteCarloStudy(self.cfg, np.asarray(self._profiles),
+        return MonteCarloStudy(self.cfg, self._profiles_np,
                                self.noise_norm, priors, seed=seed,
                                dm=self.dm, mesh=self.mesh, **kw)
 
@@ -1124,6 +1223,9 @@ class MultiPulsarFoldEnsemble:
                 keys, dms, norms, nfolds, draw_norms, dts, profiles, freqs
             )
 
+        # donate the per-call key matrix only: every other input is
+        # staged once (_staged) and reused across run() calls
+        _donate = (0,) if donation_enabled() else ()
         prog = global_registry().get_or_build(
             ("hetero_fold", cfg, mesh, int(epochs), self.epoch_chunk,
              trace_env_key()),
@@ -1143,7 +1245,8 @@ class MultiPulsarFoldEnsemble:
                         P(CHAN_AXIS),                # chan ids
                     ),
                     out_specs=P(OBS_AXIS, None, CHAN_AXIS, None),
-                )
+                ),
+                donate_argnums=_donate,
             ))
         self._compiled[cache_key] = prog
         return prog
@@ -1168,31 +1271,31 @@ class MultiPulsarFoldEnsemble:
 
         staged = dict(
             padded=jnp.asarray(padded),
-            dms=jax.device_put(
+            dms=put_sharded(
                 np.asarray([self.workloads[i][3] for i in padded], np.float32),
                 obs_sh),
-            norms=jax.device_put(
+            norms=put_sharded(
                 np.asarray([self.workloads[i][2] for i in padded], np.float32),
                 obs_sh),
-            nfolds=jax.device_put(
+            nfolds=put_sharded(
                 _check_hetero_nfolds(
                     np.asarray([self.workloads[i][0].nfold for i in padded],
                                np.float32)), obs_sh),
-            draw_norms=jax.device_put(
+            draw_norms=put_sharded(
                 np.asarray([self.workloads[i][0].draw_norm for i in padded],
                            np.float32), obs_sh),
-            dts=jax.device_put(
+            dts=put_sharded(
                 np.asarray([self.workloads[i][0].dt_ms for i in padded],
                            np.float32), obs_sh),
-            profiles=jax.device_put(
+            profiles=put_sharded(
                 np.stack([np.asarray(self.workloads[i][1], np.float32)
                           for i in padded]),
                 NamedSharding(self.mesh, P(OBS_AXIS, CHAN_AXIS, None))),
-            freqs=jax.device_put(
+            freqs=put_sharded(
                 np.stack([np.asarray(
                     self.workloads[i][0].meta.dat_freq_mhz(), np.float32)
                     for i in padded]), obs_chan_sh),
-            chan_ids=jax.device_put(np.arange(nchan), chan_sh),
+            chan_ids=put_sharded(np.arange(nchan), chan_sh),
             obs_sharding=obs_sh,
         )
         self._bucket_data[bkey] = staged
@@ -1230,7 +1333,7 @@ class MultiPulsarFoldEnsemble:
                 ),
                 in_axes=(0, None),
             )(st["padded"], epoch_start + jnp.arange(epochs))
-            keys = jax.device_put(keys, st["obs_sharding"])
+            keys = put_sharded(keys, st["obs_sharding"])
 
             prog = self._program(bkey, cfg0, epochs)
             out = prog(
@@ -1238,6 +1341,12 @@ class MultiPulsarFoldEnsemble:
                 st["draw_norms"], st["dts"], st["profiles"], st["freqs"],
                 st["chan_ids"],
             )
+            from ..runtime.dist import is_pod
+
+            if is_pod():
+                # pod rule: no eager slicing of global arrays — fetch
+                # the whole bucket through the dist layer, slice on host
+                out = pod_device_get(out)
             for slot, idx in enumerate(members):
                 results[idx] = out[slot]
         return results

@@ -27,8 +27,17 @@
   telemetry, persistent-compilation-cache wiring) that the ensemble,
   Monte-Carlo, export, and serving program families all resolve through
   instead of holding private jit caches.
+- :mod:`~psrsigsim_tpu.runtime.dist` — the multi-host pod runtime:
+  ``jax.distributed`` coordinator bootstrap with a byte-identical
+  single-process fallback, pod-safe global-array staging/fetch
+  (:func:`put_sharded` / pod ``device_get``), the leader-rooted control
+  channel with its peer-death watchdog, and the topology fingerprints
+  the program registry and persistent compilation cache key on.
 """
 
+from .dist import (PodChannel, PodInfo, PodPeerLost, device_get, init_pod,
+                   is_leader, is_pod, pod_info, pod_key, put_sharded,
+                   shutdown_pod)
 from .faults import FaultPlan
 from .integrity import (IntegrityChecker, IntegrityError,
                         resolve_integrity, scrub_dataset_dir,
@@ -43,6 +52,17 @@ from .telemetry import StageTimers
 
 __all__ = [
     "FaultPlan",
+    "PodChannel",
+    "PodInfo",
+    "PodPeerLost",
+    "init_pod",
+    "pod_info",
+    "pod_key",
+    "is_pod",
+    "is_leader",
+    "put_sharded",
+    "device_get",
+    "shutdown_pod",
     "IntegrityChecker",
     "IntegrityError",
     "resolve_integrity",

@@ -1,0 +1,791 @@
+"""Multi-host pod runtime: coordinator bootstrap, global meshes, and the
+host-side primitives that let one logical program span every chip across
+every host in a pod slice.
+
+Every compiled program in the repo shards over a ``jax.sharding.Mesh``;
+until this module that mesh was always ONE process's local devices —
+PR 7's :class:`~psrsigsim_tpu.serve.ReplicaFleet` scales processes, not
+meshes (ROADMAP item 1).  SNIPPETS.md [1] names the missing mechanism:
+on multi-process platforms "pjit can be used to run computations across
+all available devices across processes."  This module is that story,
+end to end:
+
+* :func:`init_pod` — coordinator bootstrap.  Reads the ``PSS_POD_*``
+  environment (or explicit arguments), wires CPU collectives (gloo) when
+  the platform needs them, and calls ``jax.distributed.initialize`` —
+  after which ``jax.devices()`` returns the GLOBAL device list and the
+  existing :func:`~psrsigsim_tpu.parallel.make_mesh` builds a pod-wide
+  mesh with no further changes.  Unconfigured, it is a no-op: every
+  consumer takes exactly the pre-pod code path (the single-process
+  fallback is byte-identical by construction).
+* :func:`put_sharded` / :func:`device_get` — the two operations that
+  differ under a pod.  ``jax.device_put`` refuses typed-key arrays on
+  non-addressable shardings, so ``put_sharded`` assembles the global
+  array from per-device slices of the (replicated) host value — every
+  process stages the SAME host bytes, each placing only its addressable
+  shards.  ``device_get`` replicates a global array in-graph (a cached
+  all-gather identity program per (sharding, shape, dtype)) and reads
+  the local copy, so every process returns the FULL host array and the
+  downstream host logic (journals, writers, result merges) runs the
+  same control flow everywhere — which is what keeps a pod in lockstep
+  without a consensus protocol.
+* :class:`PodChannel` — a loopback-free TCP side channel (leader binds,
+  followers connect) carrying control traffic the SPMD program cannot:
+  the serving layer's batch broadcast, barriers, and the peer-death
+  watchdog.  A follower SIGKILL'd mid-run must surface as a supervisor
+  restart of the whole program group, NOT a hang in a collective — the
+  watchdog turns peer-socket EOF into an immediate loud exit
+  (:data:`POD_PEER_EXIT`), which the process supervisor sees like any
+  other death.
+* :func:`pod_key` / :func:`compile_cache_path` — the registry/cache
+  audit hooks: program-registry keys fold in the (process-id-
+  independent) pod topology via
+  :func:`~psrsigsim_tpu.runtime.programs.trace_env_key`, and the
+  persistent compilation cache lands in a per-host-count subdirectory,
+  so a cached single-host program can never be served to a pod mesh.
+
+Reproducibility: all randomness is keyed by (seed, GLOBAL index), so a
+pod mesh with the same global device count computes bit-identical
+results at any host count {1, 2, 4, ...} — the pod analogue of the
+chunk-size invariance, pinned by tests/pod_runner.py the same way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import socket
+import struct
+import sys
+import threading
+import time
+
+__all__ = ["init_pod", "pod_info", "is_pod", "is_leader", "pod_key",
+           "put_sharded", "device_get", "local_rows", "pod_process_mesh",
+           "compile_cache_path", "PodChannel", "PodPeerLost", "PodInfo",
+           "pod_channel", "pod_barrier", "shutdown_pod", "POD_PEER_EXIT",
+           "free_ports"]
+
+#: exit code of a process that lost a pod peer mid-run: deterministic
+#: and loud, so the supervising layer restarts the whole program group
+#: instead of diagnosing a wedged collective
+POD_PEER_EXIT = 73
+
+_FRAME = struct.Struct("!I")
+_BYE = b"\x00POD-BYE\x00"
+
+
+def free_ports(n=1):
+    """Allocate ``n`` distinct kernel-assigned loopback ports (bind to
+    port 0, read the name, close).  Every pod launcher — the fleet's
+    group spawner, the smoke gates, the cluster test harnesses — needs
+    coordinator + channel ports for processes it is ABOUT to spawn;
+    this is the one shared implementation.  All ``n`` sockets are held
+    open until the last is bound so the returned ports are distinct."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for sk in socks:
+            sk.bind(("127.0.0.1", 0))
+        return [sk.getsockname()[1] for sk in socks]
+    finally:
+        for sk in socks:
+            sk.close()
+
+
+class PodPeerLost(RuntimeError):
+    """A pod peer died (socket EOF without the clean-shutdown frame)."""
+
+
+class PodInfo:
+    """This process's pod coordinates (immutable after :func:`init_pod`)."""
+
+    def __init__(self, process_id=0, num_processes=1, coordinator=None,
+                 channel_port=None, initialized=False):
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.coordinator = coordinator
+        self.channel_port = channel_port
+        self.initialized = bool(initialized)
+
+    @property
+    def is_pod(self):
+        return self.initialized and self.num_processes > 1
+
+    @property
+    def is_leader(self):
+        return self.process_id == 0
+
+    def describe(self):
+        return {"process_id": self.process_id,
+                "num_processes": self.num_processes,
+                "is_pod": self.is_pod}
+
+    def __repr__(self):
+        return (f"PodInfo(process_id={self.process_id}, "
+                f"num_processes={self.num_processes}, "
+                f"initialized={self.initialized})")
+
+
+_SOLO = PodInfo()
+_pod = _SOLO
+_channel = None
+_lock = threading.Lock()
+
+
+def _env_int(name):
+    v = os.environ.get(name, "").strip()
+    return int(v) if v else None
+
+
+def _jax_backend_started():
+    """Best-effort: has any XLA backend already initialized?  The pod
+    MUST bootstrap before the first backend touch (the CPU collectives
+    option and the distributed client bind at backend creation)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # pragma: no cover - internal layout drift
+        return False
+
+
+# Pod membership IS process-global state: one process is one pod
+# member, jax.distributed itself is a process-global singleton, and
+# every consumer (registry keys, cache paths, leader gates) must see
+# ONE consistent topology.  Rebinding is confined to the explicit
+# lifecycle entries (init/shutdown/test-reset), each PSR105-suppressed.
+def init_pod(coordinator=None, num_processes=None,  # psrlint: disable=PSR105
+             process_id=None, channel_port=None, channel=True,
+             timeout_s=60.0):
+    """Join (or skip) the pod.  Idempotent.
+
+    Args default from the environment: ``PSS_POD_COORDINATOR``
+    (``host:port`` of process 0's coordinator service),
+    ``PSS_POD_NUM_PROCESSES``, ``PSS_POD_PROCESS_ID``,
+    ``PSS_POD_CHANNEL_PORT`` (default: coordinator port + 1; the host
+    side channel binds on the leader).  With no coordinator configured
+    (or ``num_processes`` <= 1) this registers the single-process
+    fallback and changes NOTHING — every dist helper reduces to the
+    plain jax call, and compiled programs are exactly the pre-pod ones.
+
+    Must run before the first jax computation: the CPU-collectives
+    wiring and the distributed client attach at backend creation.
+    """
+    global _pod, _channel
+    with _lock:
+        if _pod.initialized:
+            return _pod
+        coordinator = coordinator or os.environ.get("PSS_POD_COORDINATOR")
+        num_processes = (num_processes if num_processes is not None
+                         else _env_int("PSS_POD_NUM_PROCESSES"))
+        process_id = (process_id if process_id is not None
+                      else _env_int("PSS_POD_PROCESS_ID"))
+        if not coordinator or not num_processes or num_processes <= 1:
+            _pod = PodInfo(initialized=True)
+            return _pod
+        if process_id is None:
+            raise ValueError(
+                "pod bootstrap needs a process id: set PSS_POD_PROCESS_ID "
+                "(or pass process_id=)")
+        if _jax_backend_started():
+            raise RuntimeError(
+                "init_pod() must run before the first jax computation "
+                "(an XLA backend is already initialized); call it at "
+                "process start, right after importing jax")
+        import jax
+
+        # CPU multi-process execution needs an explicit collectives
+        # implementation (the default 'none' refuses cross-process
+        # programs outright); accelerator backends bring their own.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - option drift across jax
+            pass
+        jax.distributed.initialize(coordinator_address=str(coordinator),
+                                   num_processes=int(num_processes),
+                                   process_id=int(process_id))
+        info = PodInfo(process_id=process_id, num_processes=num_processes,
+                       coordinator=str(coordinator), initialized=True)
+        if channel:
+            port = (channel_port if channel_port is not None
+                    else _env_int("PSS_POD_CHANNEL_PORT"))
+            if port is None:
+                port = int(str(coordinator).rsplit(":", 1)[1]) + 1
+            info.channel_port = int(port)
+            _channel = PodChannel(info, int(port), timeout_s=timeout_s)
+        _pod = info
+        return _pod
+
+
+def pod_info():
+    """This process's :class:`PodInfo` (the solo default before
+    :func:`init_pod` runs)."""
+    return _pod
+
+
+def pod_channel():
+    """The bootstrap :class:`PodChannel` (None when solo / disabled)."""
+    return _channel
+
+
+def is_pod():
+    return _pod.is_pod
+
+
+def is_leader():
+    """True when this process owns the pod's host-side effects (journal
+    writes, manifests, HTTP endpoints).  Solo processes lead trivially."""
+    return _pod.is_leader
+
+
+def pod_key():
+    """The registry-key topology fingerprint: process-id-INDEPENDENT (a
+    pod's processes must resolve identical keys) but host-count-aware (a
+    single-host program must never be served to a pod mesh).  Folded
+    into every device-program registry key via
+    :func:`~psrsigsim_tpu.runtime.programs.trace_env_key`."""
+    if not _pod.is_pod:
+        return ("solo",)
+    return ("pod", _pod.num_processes)
+
+
+def compile_cache_path(base):
+    """The persistent-compilation-cache directory for THIS topology: a
+    ``hosts<N>`` subdirectory under a pod, ``base`` itself when solo —
+    the cache-path half of the key audit (jax's own cache key covers
+    device assignment, but a shared artifact store must stay legible:
+    one topology, one directory, and a joining host warms from exactly
+    its pod's artifacts)."""
+    if not _pod.is_pod:
+        return str(base)
+    return os.path.join(str(base), f"hosts{_pod.num_processes}")
+
+
+def pod_barrier(tag="sync", timeout_s=120.0):
+    """Channel-based host barrier (no-op when solo / channel disabled)."""
+    if _channel is not None:
+        _channel.barrier(tag, timeout_s=timeout_s)
+
+
+def shutdown_pod():  # psrlint: disable=PSR105 (the pod lifecycle; see init_pod)
+    """Clean pod teardown: send the clean-shutdown frame on the watch
+    socket (so peers don't mistake this exit for a death) and close the
+    channel.  Safe to call when solo (no-op)."""
+    global _channel
+    ch = _channel
+    _channel = None
+    if ch is not None:
+        ch.close()
+
+
+# ---------------------------------------------------------------------------
+# global-array staging and fetch
+# ---------------------------------------------------------------------------
+
+
+def put_sharded(x, sharding):
+    """Place a (replicated) host value onto ``sharding`` — the pod-safe
+    ``jax.device_put``.
+
+    Solo (or addressable shardings): exactly ``jax.device_put(x,
+    sharding)`` — the pre-pod behavior, bit for bit.  Under a pod every
+    process calls this with the SAME host value; each slices out and
+    places only its addressable shards and assembles the global array
+    (``make_array_from_single_device_arrays``), which is the only
+    staging path that also carries typed PRNG-key arrays."""
+    import jax
+
+    if (not _pod.is_pod) or getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    shape = x.shape if hasattr(x, "shape") else np.shape(x)
+    idx_map = sharding.addressable_devices_indices_map(tuple(shape))
+    arrs = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), sharding, arrs)
+
+
+def _replicate(x):
+    """A fully-replicated copy of a global array: one cached identity
+    program per (sharding, shape, dtype) whose output sharding drops
+    every partition — XLA lowers it to the all-gather this fetch IS.
+    Resolved through the shared program registry (family
+    ``pod_replicate``) so builds are counted like any other program."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .programs import global_registry
+
+    sharding = x.sharding
+    out_sh = NamedSharding(sharding.mesh, PartitionSpec())
+    prog = global_registry().get_or_build(
+        ("pod_replicate", sharding, tuple(x.shape), str(x.dtype)),
+        lambda: jax.jit(lambda a: a, out_shardings=out_sh))
+    return prog(x)
+
+
+def _channel_fetch(x, ch):
+    """Exchange one global array's shards over the pod channel: every
+    process fetches its LOCAL shards (no collective), followers ship
+    theirs to the leader, and the leader returns each follower only the
+    COMPLEMENT of its own shards (every process already holds 1/N of
+    the bytes locally — re-sending them would pay ~2x the necessary
+    leader egress per chunk) — all on the strictly-FIFO ctl stream.
+
+    This is the DEFAULT pod fetch because it is deterministic by
+    construction: in-graph all-gathers from overlapping programs share
+    the backend's collective streams, and on the CPU/gloo stack an
+    interleaving across the dispatch-ahead window can corrupt or wedge
+    them.  The channel path involves no collectives at all; the
+    in-graph path stays available for real accelerator pods
+    (``PSS_POD_FETCH=collective`` — ICI all-gathers dwarf loopback
+    TCP).
+
+    Every frame carries the per-process monotonic fetch sequence number
+    and the leaf shape/dtype: lockstep is an INVARIANT, so a divergence
+    (one side skipped a chunk the other computed) must surface as this
+    loud mismatch — never as shape-compatible shards of the wrong chunk
+    silently assembled into the result."""
+    import numpy as np
+
+    seq = ch.next_fetch_seq()
+    meta = (tuple(x.shape), str(x.dtype))
+    local = [(s.index, np.asarray(s.data)) for s in x.addressable_shards]
+    if _pod.is_leader:
+        out = np.zeros(x.shape, x.dtype)
+        for idx, block in local:
+            out[idx] = block
+        peer = {}
+        for pid, payload in ch.gather().items():
+            tag, got_seq, got_meta, shards = payload
+            if tag != "pod-fetch" or got_seq != seq or got_meta != meta:
+                raise RuntimeError(
+                    f"pod fetch #{seq} {meta}: peer {pid} sent "
+                    f"{(tag, got_seq, got_meta)!r} — program groups out "
+                    "of lockstep")
+            for idx, block in shards:
+                out[idx] = block
+            peer[pid] = shards
+        for pid in peer:
+            parts = list(local)
+            for other, shards in peer.items():
+                if other != pid:
+                    parts.extend(shards)
+            ch.send_to(pid, ("pod-fetch-part", seq, meta, parts))
+        return out
+    ch.send_to_leader(("pod-fetch", seq, meta, local))
+    tag, got_seq, got_meta, parts = ch.recv()
+    if tag != "pod-fetch-part" or got_seq != seq or got_meta != meta:
+        raise RuntimeError(
+            f"pod fetch #{seq} {meta}: leader sent "
+            f"{(tag, got_seq, got_meta)!r} — program groups out of "
+            "lockstep")
+    out = np.zeros(x.shape, x.dtype)
+    for idx, block in local:
+        out[idx] = block
+    for idx, block in parts:
+        out[idx] = block
+    return out
+
+
+def device_get(tree):
+    """Fetch a pytree of device arrays to host — the pod-safe
+    ``jax.device_get``.
+
+    Solo: exactly ``jax.device_get(tree)``.  Under a pod, leaves whose
+    shards span other hosts are exchanged over the pod channel
+    (:func:`_channel_fetch`, the deterministic default) or replicated
+    in-graph (``PSS_POD_FETCH=collective`` — :func:`_replicate`, for
+    accelerator pods with native collective fabrics) — either way EVERY
+    process returns the full host value, so downstream host logic
+    (quarantine decisions, journal commits, result merges) takes
+    identical branches on every host.  That lockstep is the pod's
+    consistency model: the fetch is also the rendezvous.
+
+    Single-owner rule: one thread per process drives pod fetches at a
+    time (the chunk pipelines' fetch thread, the serve batcher, or the
+    study loop) — the channel stream is FIFO, not multiplexed."""
+    import jax
+
+    if not _pod.is_pod:
+        return jax.device_get(tree)
+    import numpy as np
+
+    mode = os.environ.get("PSS_POD_FETCH", "channel").strip().lower()
+    ch = _channel if mode != "collective" else None
+    if mode not in ("channel", "collective"):
+        raise ValueError(f"PSS_POD_FETCH={mode!r}: use channel or "
+                         "collective")
+    if mode == "channel" and ch is None:
+        raise RuntimeError("pod fetch needs the pod channel (init_pod "
+                           "with channel=True), or PSS_POD_FETCH="
+                           "collective")
+
+    def _leaf(x):
+        if not isinstance(x, jax.Array) or x.is_fully_addressable:
+            return jax.device_get(x)
+        if ch is not None:
+            return _channel_fetch(x, ch)
+        full = _replicate(x)
+        return np.asarray(full.addressable_shards[0].data)
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
+def local_rows(arr):
+    """This process's rows of a leading-axis-sharded global array:
+    ``(global_row_indices, host_block)`` — the per-host view identity
+    tests hash (no collective, no cross-host traffic)."""
+    import numpy as np
+
+    shards = sorted(arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    idx = np.concatenate([
+        np.arange(s.index[0].start or 0,
+                  s.index[0].stop if s.index[0].stop is not None
+                  else arr.shape[0])
+        for s in shards])
+    block = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return idx, block
+
+
+def pod_process_mesh():
+    """A 2-D ``(obs, chan)`` mesh with ONE device per pod process —
+    the serving layer's pod mesh (request batches are small; what a pod
+    replica spans is HOSTS, with obs rows split one slab per host).
+    Solo: the first local device only."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ..parallel.mesh import CHAN_AXIS, OBS_AXIS
+
+    seen = set()
+    devs = []
+    for d in jax.devices():
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            devs.append(d)
+    return Mesh(np.array(devs).reshape(len(devs), 1), (OBS_AXIS, CHAN_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# the host-side channel
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock, payload):
+    sock.sendall(_FRAME.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise PodPeerLost("pod peer closed the channel mid-frame")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
+    return _recv_exact(sock, n)
+
+
+#: the hello handshake is a FIXED-SIZE, HMAC-authenticated frame — the
+#: one part of the channel protocol that reads bytes from a socket that
+#: has not proven it is a pod peer, so it must never touch pickle (a
+#: crafted pickle IS remote code execution) and must reject forgeries
+#: before a stray/hostile connection can claim a follower slot
+_HELLO = struct.Struct("!cI")   # kind byte (c=ctl, w=watch) + process id
+_HELLO_MAC = hashlib.sha256().digest_size
+
+
+def _channel_token(info):
+    """The shared channel secret: ``PSS_POD_TOKEN`` when the operator
+    sets one (REQUIRED on any non-loopback deployment), else derived
+    from the pod coordinates so same-machine clusters authenticate
+    against casual strays without configuration."""
+    tok = os.environ.get("PSS_POD_TOKEN")
+    if tok:
+        return tok.encode()
+    return hashlib.sha256(
+        f"pss-pod:{info.coordinator}:{info.num_processes}".encode()
+    ).digest()
+
+
+def _hello_frame(kind, pid, token):
+    head = _HELLO.pack(b"c" if kind == "ctl" else b"w", pid)
+    mac = hmac.new(token, b"pss-pod-hello" + head, hashlib.sha256).digest()
+    return head + mac
+
+
+class PodChannel:
+    """Leader-rooted control channel + peer-death watchdog.
+
+    Two sockets per follower: a ``ctl`` stream carrying protocol frames
+    (length-prefixed pickles — safe because every peer first proved
+    itself with the HMAC hello below; nothing pickled is ever read from
+    an unauthenticated socket) and a ``watch`` stream that carries
+    NOTHING except the clean-
+    shutdown frame: a watchdog thread blocks on it, and EOF without
+    :data:`_BYE` means the peer died — the default reaction is an
+    immediate ``os._exit(POD_PEER_EXIT)``, turning a wedged-collective
+    hang into a process death the supervising layer already knows how
+    to restart.  Pass ``on_peer_lost`` to override (tests).
+    """
+
+    def __init__(self, info, port, timeout_s=60.0, on_peer_lost=None):
+        self.info = info
+        self.port = int(port)
+        self._on_peer_lost = on_peer_lost
+        self._closing = threading.Event()
+        self._ctl = {}     # peer process id -> ctl socket
+        self._watch = {}   # peer process id -> watch socket
+        self._ctl_lock = threading.Lock()
+        self._fetch_seq = 0   # single fetch-driver thread per process
+        # the channel is rooted on the leader's machine — process 0 IS
+        # the coordinator host, so followers dial the coordinator's
+        # address (a hardcoded loopback would strand every genuinely
+        # multi-machine pod), and the leader binds THAT address, so a
+        # loopback-coordinated local cluster never listens off-box
+        host = "127.0.0.1"
+        if info.coordinator:
+            host = str(info.coordinator).rsplit(":", 1)[0] or host
+        self._token = _channel_token(info)
+        if info.is_leader:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                srv.bind((host, self.port))
+            except OSError:
+                # the coordinator name may not be a bindable local
+                # address in some container/NAT setups; fall back to
+                # all interfaces (the authenticated hello still gates
+                # who gets a peer slot)
+                srv.bind(("", self.port))
+            srv.listen(2 * info.num_processes)
+            srv.settimeout(timeout_s)
+            self._srv = srv
+            need = 2 * (info.num_processes - 1)
+            deadline = time.monotonic() + timeout_s
+            got = 0
+            while got < need:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"pod channel: {need - got} follower socket(s) "
+                        f"never connected within {timeout_s}s")
+                conn, _ = srv.accept()
+                # accept()ed sockets are blocking regardless of the
+                # listener timeout; a peer that connects but never
+                # sends its hello (stray scanner, wedged follower)
+                # must hit the bootstrap deadline, not hang forever
+                conn.settimeout(max(0.1, deadline - time.monotonic()))
+                try:
+                    raw = _recv_exact(conn, _HELLO.size + _HELLO_MAC)
+                except (OSError, PodPeerLost):
+                    # not a follower (or a dead one): drop it and keep
+                    # accepting — the deadline check above still turns
+                    # a missing peer into the advertised TimeoutError
+                    conn.close()
+                    continue
+                head, mac = raw[:_HELLO.size], raw[_HELLO.size:]
+                want = hmac.new(self._token, b"pss-pod-hello" + head,
+                                hashlib.sha256).digest()
+                kbyte, pid = _HELLO.unpack(head)
+                store = self._ctl if kbyte == b"c" else self._watch
+                if not hmac.compare_digest(mac, want) or pid in store:
+                    # forged/garbled hello, or a slot already filled by
+                    # an authenticated peer: never let it displace (or
+                    # satisfy the count for) a real follower
+                    conn.close()
+                    continue
+                conn.settimeout(None)
+                store[pid] = conn
+                got += 1
+        else:
+            self._srv = None
+            for kind, store in (("ctl", self._ctl), ("watch", self._watch)):
+                store[0] = self._connect(host, kind, timeout_s)
+        self._watchers = []
+        for pid, sock in self._watch.items():
+            t = threading.Thread(target=self._watch_peer, args=(pid, sock),
+                                 daemon=True, name=f"pss-pod-watch-{pid}")
+            t.start()
+            self._watchers.append(t)
+
+    def _connect(self, host, kind, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                s = socket.create_connection((host, self.port), timeout=5.0)
+                s.settimeout(None)
+                s.sendall(_hello_frame(kind, self.info.process_id,
+                                       self._token))
+                return s
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"pod channel: leader at port {self.port} never "
+                        f"accepted within {timeout_s}s")
+                time.sleep(0.05)
+
+    # -- watchdog ----------------------------------------------------------
+
+    def _watch_peer(self, pid, sock):
+        # read until EOF or the full shutdown frame: TCP may fragment
+        # the tiny _BYE (cross-host pods especially), and a partial
+        # first recv must not be mistaken for peer death
+        data = b""
+        try:
+            while len(data) < len(_BYE):
+                chunk = sock.recv(len(_BYE) - len(data))
+                if not chunk:
+                    break
+                data += chunk
+        except OSError:
+            pass
+        if data == _BYE:
+            return
+        self._peer_dead(pid)
+
+    def _peer_dead(self, pid):
+        """One reaction to peer death for BOTH detection paths (the
+        watch stream's EOF and a :class:`PodPeerLost` on the ctl
+        stream): the exit-code contract (``POD_PEER_EXIT``, never an
+        arbitrary unwind's rc) must not depend on which thread notices
+        first."""
+        if self._closing.is_set():
+            return   # clean teardown: EOFs are expected
+        if self._on_peer_lost is not None:
+            self._on_peer_lost(pid)
+            return
+        print(f"pod: peer process {pid} died (channel EOF); aborting "
+              f"this program group for a clean supervisor restart",
+              file=sys.stderr, flush=True)
+        sys.stderr.flush()
+        os._exit(POD_PEER_EXIT)
+
+    # -- control traffic ---------------------------------------------------
+
+    def next_fetch_seq(self):
+        """The per-process monotonic fetch counter stamped onto every
+        :func:`_channel_fetch` frame (the documented single-owner rule:
+        one thread per process drives fetches, so no lock)."""
+        self._fetch_seq += 1
+        return self._fetch_seq
+
+    def broadcast(self, obj):
+        """Leader -> every follower (one frame each, FIFO per peer)."""
+        payload = pickle.dumps(obj, protocol=4)
+        with self._ctl_lock:
+            for sock in self._ctl.values():
+                _send_frame(sock, payload)
+
+    def send_to(self, pid, obj):
+        """Leader -> ONE follower (FIFO on that peer's ctl stream) —
+        the per-peer half of the complement fetch exchange."""
+        payload = pickle.dumps(obj, protocol=4)
+        with self._ctl_lock:
+            _send_frame(self._ctl[pid], payload)
+
+    def recv(self):
+        """Follower: the next leader frame (blocks)."""
+        try:
+            return pickle.loads(_recv_frame(self._ctl[0]))
+        except PodPeerLost:
+            # ctl EOF races the watch stream's EOF on a dead peer; take
+            # the SAME deterministic exit path rather than let whichever
+            # thread is scheduled first pick the process's exit code
+            self._peer_dead(0)
+            raise
+
+    def send_to_leader(self, obj):
+        _send_frame(self._ctl[0], pickle.dumps(obj, protocol=4))
+
+    def gather(self):
+        """Leader: one frame from EVERY follower -> {pid: obj}."""
+        out = {}
+        for pid, sock in self._ctl.items():
+            try:
+                out[pid] = pickle.loads(_recv_frame(sock))
+            except PodPeerLost:
+                self._peer_dead(pid)
+                raise
+        return out
+
+    def barrier(self, tag="sync", timeout_s=120.0):
+        """All processes rendezvous: followers report in, the leader
+        acks.  (Leader-rooted, like everything on this channel.)"""
+        if self.info.is_leader:
+            for pid, got in self.gather().items():
+                if got != ("barrier", tag):
+                    raise RuntimeError(
+                        f"pod barrier {tag!r}: peer {pid} sent {got!r} "
+                        "(program groups out of lockstep)")
+            self.broadcast(("barrier-ack", tag))
+        else:
+            self.send_to_leader(("barrier", tag))
+            got = self.recv()
+            if got != ("barrier-ack", tag):
+                raise RuntimeError(
+                    f"pod barrier {tag!r}: leader sent {got!r} "
+                    "(program groups out of lockstep)")
+
+    def close(self):
+        """Clean shutdown: BYE on every watch socket, close everything.
+        Idempotent."""
+        if self._closing.is_set():
+            return
+        self._closing.set()
+        for sock in self._watch.values():
+            try:
+                sock.sendall(_BYE)
+            except OSError:
+                pass
+        for sock in list(self._ctl.values()) + list(self._watch.values()):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+
+
+def pod_health():
+    """JSON-ready pod status for /healthz-style consumers."""
+    info = _pod.describe()
+    info["channel"] = _channel is not None
+    return info
+
+
+def _reset_for_tests():  # psrlint: disable=PSR105 (the pod lifecycle)
+    """TESTS ONLY: forget the pod state (the solo fallback returns).
+    Does not tear down jax.distributed — only meaningful in processes
+    that never initialized it (fake-topology registry audits)."""
+    global _pod, _channel
+    if _channel is not None:
+        _channel.close()
+    _pod = _SOLO
+    _channel = None
+
+
+def fake_pod_for_tests(num_processes, process_id=0):  # psrlint: disable=PSR105
+    """TESTS ONLY: install a :class:`PodInfo` WITHOUT touching jax —
+    the simulated topology the registry/cache key audit runs across
+    (program keys must fork on topology even where no real cluster can
+    exist, e.g. inside one pytest process).  Returns the previous state
+    so callers can restore it."""
+    global _pod
+    prev = _pod
+    _pod = PodInfo(process_id=process_id, num_processes=num_processes,
+                   initialized=True)
+    return prev

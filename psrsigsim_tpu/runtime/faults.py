@@ -133,6 +133,17 @@ point                     where it fires
                           (:mod:`psrsigsim_tpu.runtime.integrity`)
                           exists to find.  Config: ``match`` (file
                           basename / spec hash) / ``times``.
+``pod.kill``              a pod FOLLOWER process
+                          (tests/fault_runner.py pod mode), after the
+                          ``after_chunks``-th chunk of its mirrored
+                          export loop completed — SIGKILLs the follower
+                          (a host dying mid-run).  The leader's channel
+                          watchdog must turn that into a LOUD whole-
+                          group abort (exit ``POD_PEER_EXIT``, never a
+                          wedged collective), and a clean relaunch of
+                          the full group resumes to byte-identical
+                          output (tests/test_pod.py TestPodKill).
+                          Config: ``{"after_chunks": int}``.
 ``cache.enospc``          :meth:`psrsigsim_tpu.serve.ResultCache.put`
                           — raises ``OSError(ENOSPC)`` mid-commit, the
                           disk-full case for the shared cache tier.
@@ -175,7 +186,7 @@ POINTS = ("writer.crash", "shm.attach", "file.partial", "nan.obs",
           "run.kill", "mc.kill", "dataset.kill", "serve.kill",
           "serve.reject", "replica.kill", "cache.contend",
           "route.blackhole", "replica.slow", "cache.enospc",
-          "device.sdc", "host.corrupt", "disk.bitrot")
+          "device.sdc", "host.corrupt", "disk.bitrot", "pod.kill")
 
 
 class FaultPlan:

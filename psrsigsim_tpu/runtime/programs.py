@@ -44,19 +44,49 @@ import threading
 import time
 
 __all__ = ["ProgramRegistry", "global_registry", "enable_compilation_cache",
-           "trace_env_key"]
+           "trace_env_key", "donation_enabled"]
+
+
+def donation_enabled():
+    """Should the chunked hot-loop programs donate their per-chunk
+    index/key input buffers (``jit(donate_argnums=...)``)?  Donation
+    lets XLA alias a dying input's HBM into the outputs, so pod-scale
+    batches don't double-buffer — values are unchanged by construction
+    (pinned donation-on vs -off by tests/test_pod.py).
+
+    ``PSS_DONATE``: ``1`` forces on, ``0`` forces off, unset/``auto``
+    enables it exactly where it pays — accelerator backends (the CPU
+    backend ignores donation, and the default keeps CPU test programs
+    byte-for-byte the pre-donation ones)."""
+    import os
+
+    v = os.environ.get("PSS_DONATE", "auto").strip().lower()
+    if v in ("1", "on", "true", "yes"):
+        return True
+    if v in ("0", "off", "false", "no"):
+        return False
+    if v in ("", "auto"):
+        import jax
+
+        return jax.default_backend() != "cpu"
+    raise ValueError(f"PSS_DONATE={v!r}: use 1, 0, or auto")
 
 
 def trace_env_key():
     """The trace-time environment knobs that change what a compiled
-    program COMPUTES (ops/stats.py reads them while tracing): the
-    sampler backend selector, the exact-chi2 escape hatch, and the
-    exact-shift escape hatch.  Every registry key for a program that
-    draws random fields must include this tuple — per-instance jit
-    caches died with their instances, so a flipped env var used to get
-    a fresh trace for free; the process-global registry must key on it
-    explicitly or it would silently serve programs traced under the old
-    settings.
+    program COMPUTES (ops/stats.py reads them while tracing) or how it
+    is BUILT: the sampler backend selector, the exact-chi2 escape
+    hatch, the exact-shift escape hatch, the buffer-donation switch
+    (:func:`donation_enabled` — donated programs alias their inputs,
+    so a flipped switch must resolve a fresh build), and the pod
+    topology (:func:`psrsigsim_tpu.runtime.dist.pod_key` — a program
+    compiled for a single-host mesh must never be served to a pod, and
+    every process of one pod must resolve identical, process-id-
+    independent keys).  Every registry key for a device program must
+    include this tuple — per-instance jit caches died with their
+    instances, so a flipped env var used to get a fresh trace for free;
+    the process-global registry must key on it explicitly or it would
+    silently serve programs traced under the old settings.
 
     The key is captured at CONSTRUCTION time while jit traces lazily at
     first dispatch — so the documented contract for these variables
@@ -67,18 +97,31 @@ def trace_env_key():
     construction)."""
     import os
 
+    from .dist import pod_key
+
     return (os.environ.get("PSS_SAMPLER", "auto"),
             bool(os.environ.get("PSS_EXACT_CHI2")),
-            bool(os.environ.get("PSS_EXACT_SHIFT")))
+            bool(os.environ.get("PSS_EXACT_SHIFT")),
+            donation_enabled(),
+            pod_key())
 
 
 def enable_compilation_cache(path):
     """Point JAX's persistent compilation cache at ``path`` (created by
     JAX on first write).  Returns True when the option stuck — older/newer
     JAX spellings are tried in order and absence is non-fatal (callers
-    still work; restarts just pay compiles again)."""
+    still work; restarts just pay compiles again).
+
+    Under a pod the cache lands in a per-host-count subdirectory of
+    ``path`` (:func:`~psrsigsim_tpu.runtime.dist.compile_cache_path`):
+    single-host and pod artifacts never share a directory, and every
+    host of one pod warms from the SAME store — a joining host's warmup
+    is a disk read, not a compile (gated by ``bench.py --pod-smoke``)."""
     import jax
 
+    from .dist import compile_cache_path
+
+    path = compile_cache_path(path)
     ok = False
     try:
         jax.config.update("jax_compilation_cache_dir", str(path))

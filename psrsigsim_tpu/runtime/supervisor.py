@@ -111,6 +111,56 @@ def load_chunk_journal(path, event="chunk", key="start"):
     return {int(rec[key]): rec for rec in records if rec.get("e") == event}
 
 
+def load_resume_hashes(out_dir, journal_path=None, truncate=True):
+    """The basename -> sha256 map hash-verified resume checks committed
+    export files against, rebuilt from the manifest plus the journal's
+    commit records.  Returns ``(hashes, records)`` (the raw records so
+    :meth:`RunSupervisor._load_previous` can replay its extra events).
+
+    THE one hash source for resume: the leader's supervisor and the pod
+    follower mirror both load through here — pod lockstep depends on
+    their skip decisions deriving from the same bytes, so the loading
+    rule must not be able to drift between two copies.  Followers pass
+    ``truncate=False``: the live leader owns the journal file."""
+    from ..io.export import _load_manifest
+
+    hashes = {}
+    man = _load_manifest(out_dir)
+    if man is not None:
+        hashes.update(man.get("files", {}))
+    records, _ = load_journal_records(
+        journal_path or os.path.join(out_dir, _JOURNAL_NAME),
+        truncate=truncate)
+    for rec in records:
+        if rec.get("e") == "commit":
+            hashes.update(rec.get("files", {}))
+    return hashes, records
+
+
+def file_done_check(path, hashes, verify, verified):
+    """THE per-file resume predicate: existence under plain resume;
+    existence + sha256 match against ``hashes`` under ``verify``
+    (unknown or mismatched hashes mean "rewrite it").  Paths proven ok
+    are remembered in the caller-owned ``verified`` set so chunk-skip /
+    per-file / group predicates don't re-hash multi-GB outputs.  Shared
+    by :meth:`RunSupervisor.file_ok` and the pod follower mirror — the
+    definition of "done" must be a single point of truth."""
+    if path in verified:
+        return True
+    if not os.path.exists(path):
+        return False
+    if not verify:
+        verified.add(path)
+        return True
+    from ..io.export import _file_sha
+
+    want = hashes.get(os.path.basename(path))
+    if want is not None and _file_sha(path) == want:
+        verified.add(path)
+        return True
+    return False
+
+
 class RunResult:
     """What a supervised export run produced.
 
@@ -205,16 +255,11 @@ class RunSupervisor:
         (:func:`load_journal_records`): a newline-less tail from a
         crash is skipped and truncated, costing at most one chunk's
         re-verify."""
-        from ..io.export import _load_manifest
-
-        man = _load_manifest(self.out_dir)
-        if man is not None:
-            self._hashes.update(man.get("files", {}))
-        records, _ = load_journal_records(self.journal_path)
+        hashes, records = load_resume_hashes(self.out_dir,
+                                             self.journal_path)
+        self._hashes.update(hashes)
         for rec in records:
-            if rec.get("e") == "commit":
-                self._hashes.update(rec.get("files", {}))
-            elif rec.get("e") in ("rfi", "rfi_retry"):
+            if rec.get("e") in ("rfi", "rfi_retry"):
                 # replay the scenario-truth record so a resumed
                 # export's manifest summary stays COMPLETE (the
                 # skipped committed chunks never re-observe)
@@ -235,21 +280,10 @@ class RunSupervisor:
         A path proven ok once this run — verified here, or committed by
         this run's writers — is remembered, so the chunk-skip, per-file
         and group predicates don't re-hash multi-GB outputs two or three
-        times each."""
-        if path in self._verified:
-            return True
-        if not os.path.exists(path):
-            return False
-        if not self.verify:
-            self._verified.add(path)
-            return True
-        from ..io.export import _file_sha
-
-        want = self._hashes.get(os.path.basename(path))
-        if want is not None and _file_sha(path) == want:
-            self._verified.add(path)
-            return True
-        return False
+        times each.  (Delegates to :func:`file_done_check`, the single
+        definition of "done" the pod follower mirror also uses.)"""
+        return file_done_check(path, self._hashes, self.verify,
+                               self._verified)
 
     def poisoned_noise_norms(self, n_obs, noise_norms, default=1.0):
         """Apply the ``nan.obs`` injection point (tests only): NaN the

@@ -114,6 +114,7 @@ class StageTimers:
         self._depths = {}  # queue name -> [sum, samples, max]
         self._counters = {}  # name -> int (program builds, cache events...)
         self._gauges = {}  # name -> last-set value (degraded flags, levels)
+        self._live_bytes = 0  # dispatched-but-unfetched device bytes
 
     def add(self, stage, seconds, nbytes=0):
         """Accumulate ``seconds`` of busy time against ``stage`` (one of
@@ -168,6 +169,29 @@ class StageTimers:
     def counter(self, name):
         with self._lock:
             return self._counters.get(name, 0)
+
+    def track_live(self, tree):
+        """Add a just-dispatched device pytree's bytes to the
+        ``live_buffer_bytes`` gauge — the donation satellite's measure
+        of dispatched-but-unfetched HBM, shared by every chunked
+        producer (ensemble/MC/dataset) so the accounting lives in ONE
+        place; :meth:`untrack_live` subtracts the same tree on fetch."""
+        self._bump_live(tree, +1)
+
+    def untrack_live(self, tree):
+        """Subtract a fetched device pytree's bytes from the
+        ``live_buffer_bytes`` gauge (clamped at zero: a producer that
+        fetches a tree it never tracked must not drive the gauge
+        negative)."""
+        self._bump_live(tree, -1)
+
+    def _bump_live(self, tree, sign):
+        import jax
+
+        n = sum(int(a.nbytes) for a in jax.tree_util.tree_leaves(tree))
+        with self._lock:
+            self._live_bytes = max(0, self._live_bytes + sign * n)
+            self._gauges["live_buffer_bytes"] = self._live_bytes
 
     def gauge(self, name, value):
         """Set a named point-in-time gauge (e.g. ``cache_degraded`` while

@@ -77,12 +77,58 @@ def main(argv=None):
     ap.add_argument("--fault-plan", default=None,
                     help="TESTS ONLY: FaultPlan JSON "
                          '({"scratch_dir", "spec"}) arming serve.* points')
+    ap.add_argument("--pod-num-hosts", type=int, default=None,
+                    help="size of this replica's multi-host program "
+                         "group (>1 joins a jax.distributed pod; "
+                         "runtime/dist.py)")
+    ap.add_argument("--pod-host", type=int, default=None,
+                    help="this process's pod process id (0 = leader, "
+                         "which owns the HTTP endpoint)")
+    ap.add_argument("--pod-coordinator", default=None,
+                    help="host:port of the pod coordinator (process 0)")
+    ap.add_argument("--pod-channel-port", type=int, default=None,
+                    help="leader's host-side control-channel port "
+                         "(default: coordinator port + 1)")
+    ap.add_argument("--pod-follower", action="store_true",
+                    help="run as a follower: no HTTP socket — join the "
+                         "leader's mesh and obey its program stream")
     args = ap.parse_args(argv)
 
     # keep stdout clean for the one-line ready protocol: the OO layer's
     # reference-parity warnings print to stdout during warmup
     real_stdout = sys.stdout
     sys.stdout = sys.stderr
+
+    if args.pod_num_hosts and args.pod_num_hosts > 1:
+        # pod bootstrap MUST precede the first jax computation (the
+        # service/HTTP imports below trigger backend init)
+        from ..runtime.dist import init_pod
+
+        init_pod(coordinator=args.pod_coordinator,
+                 num_processes=args.pod_num_hosts,
+                 process_id=args.pod_host,
+                 channel_port=args.pod_channel_port)
+
+    if args.pod_follower:
+        # a follower's whole life: print the ready line the spawner
+        # waits on, then obey the leader's register/exec stream until
+        # its clean shutdown (a leader DEATH exits loudly through the
+        # channel watchdog instead)
+        from ..runtime.dist import shutdown_pod
+        from .pod import pod_serve_follower
+
+        ccd = args.compile_cache_dir
+        if ccd is None and args.cache_dir is not None:
+            import os as _os
+
+            ccd = _os.path.join(args.cache_dir, "compile_cache")
+        widths = tuple(int(w) for w in args.widths.split(","))
+        print(json.dumps({"ready": True, "pod_follower": args.pod_host,
+                          "pod_num_hosts": args.pod_num_hosts}),
+              file=real_stdout, flush=True)
+        pod_serve_follower(widths, compile_cache_dir=ccd)
+        shutdown_pod()
+        return 0
 
     from .http import make_server, run_server
     from .service import SimulationService
@@ -128,6 +174,13 @@ def main(argv=None):
               file=real_stdout, flush=True)
 
     run_server(srv, ready_cb=_ready)
+    if args.pod_num_hosts and args.pod_num_hosts > 1:
+        # leader drain: service.close() (inside run_server's shutdown)
+        # already ended the followers' stream; BYE the watchdog so this
+        # exit isn't mistaken for a death
+        from ..runtime.dist import shutdown_pod
+
+        shutdown_pod()
     return 0
 
 

@@ -1,0 +1,254 @@
+"""Pod-spanning serving: one replica = one multi-host program group.
+
+A fleet replica used to be one process owning one chip's local mesh.
+Under a pod, a replica is a GROUP: the leader process owns the HTTP
+endpoint, the result cache, and the request queue — exactly the single-
+process serving engine — while follower processes own the other hosts'
+chips and join every compiled program's mesh.  The division of labor:
+
+* :class:`PodProgramRegistry` (leader) — a drop-in
+  :class:`~psrsigsim_tpu.serve.programs.ProgramRegistry` whose compiled
+  programs span the pod: ``shard_map`` over a one-device-per-host mesh
+  (:func:`~psrsigsim_tpu.runtime.dist.pod_process_mesh`), batch rows one
+  slab per host, bucket widths rounded up to multiples of the host
+  count.  ``execute`` broadcasts each batch's inputs over the pod
+  channel BEFORE dispatching, so followers call the same program with
+  the same global arrays in the same order — the collective inside the
+  dispatch is the rendezvous.  Registry keys carry the pod topology
+  (family ``serve_pod_bucket`` + ``trace_env_key``), so a single-host
+  program can never be served to a pod mesh, and the persistent
+  compilation cache (already per-topology via
+  :func:`~psrsigsim_tpu.runtime.dist.compile_cache_path`) warms a
+  joining host from the shared artifact store.
+* :func:`pod_serve_follower` — the follower's whole life: obey the
+  leader's ``register`` / ``exec`` / ``shutdown`` stream.  Followers
+  have no HTTP socket, no cache, no queue; a follower death surfaces
+  through the channel watchdog as a loud group exit the fleet
+  supervisor restarts whole
+  (:class:`~psrsigsim_tpu.serve.ReplicaFleet` ``group_hosts``).
+
+Byte identity: every response row depends only on its request's key
+(the batching-invariance contract solo == coalesced == any width), and
+the per-host slab width is just another bucket width — pod responses
+are bit-identical to a single-host replica's, pinned by
+tests/pod_runner.py's serve leg.
+
+PRNG keys cross the channel as raw ``jax.random.key_data`` (typed key
+arrays don't pickle or stage across processes); the pod program wraps
+them back in-graph (``wrap_key_data`` — a bitcast, draw-exact).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .programs import ProgramRegistry
+
+__all__ = ["PodProgramRegistry", "build_pod_bucket_fn",
+           "pod_serve_follower"]
+
+_FAMILY = "serve_pod_bucket"
+
+
+def build_pod_bucket_fn(cfg, profiles, scenario, mesh):
+    """The pod twin of
+    :func:`~psrsigsim_tpu.parallel.build_width_bucket_fn`: the same
+    per-row physics, sharded over ``mesh``'s obs axis, taking raw key
+    DATA (uint32 ``(B, key_words)``) instead of typed keys."""
+    import jax
+
+    from ..parallel.ensemble import build_width_bucket_fn
+    from ..parallel.mesh import OBS_AXIS
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    base = build_width_bucket_fn(cfg, profiles, scenario=scenario)
+
+    def _local(kd, dms, norms, nulls, *sc):
+        keys = jax.random.wrap_key_data(kd)
+        return base(keys, dms, norms, nulls, *sc)
+
+    in_specs = (P(OBS_AXIS, None), P(OBS_AXIS), P(OBS_AXIS),
+                P(OBS_AXIS)) + ((P(OBS_AXIS, None),)
+                                if scenario is not None else ())
+    # check_rep=False: rows are per-request independent by construction;
+    # the rep checker can't see through the vmapped draws
+    return shard_map(_local, mesh=mesh, in_specs=in_specs,
+                     out_specs=P(OBS_AXIS, None, None), check_rep=False)
+
+
+class PodProgramRegistry(ProgramRegistry):
+    """Leader-side registry of pod-spanning serving programs.
+
+    ``channel``: the bootstrap :class:`~psrsigsim_tpu.runtime.dist.
+    PodChannel` (None on followers — they execute locally on the
+    leader's broadcast instead of re-broadcasting)."""
+
+    def __init__(self, widths=None, compile_cache_dir=None, channel=None):
+        from ..runtime.dist import pod_info, pod_process_mesh
+        from .programs import DEFAULT_WIDTHS
+
+        self._pod = pod_info()
+        self._channel = channel
+        nproc = max(1, self._pod.num_processes)
+        widths = tuple(DEFAULT_WIDTHS if widths is None else widths)
+        # bucket widths must tile the one-device-per-host mesh: round
+        # each up to a multiple of the host count (rows pad by wrapping,
+        # and row bytes are width-invariant by the batching contract)
+        rounded = sorted({int(w) + (-int(w)) % nproc if w >= nproc
+                          else nproc for w in widths})
+        super().__init__(widths=rounded,
+                         compile_cache_dir=compile_cache_dir)
+        self._mesh = pod_process_mesh()
+        import threading
+
+        # one frame-exchange window at a time: a register broadcast
+        # landing between an exec frame and its fetch exchange would
+        # reach the follower mid-_channel_fetch and crash the group —
+        # convention keeps register on the warmup/batcher thread today,
+        # but the invariant must hold for ANY caller of the public API
+        self._stream_lock = threading.RLock()
+        import jax
+
+        self._key_words = jax.random.key_data(jax.random.key(0)).shape
+
+    # -- leader-side broadcast hooks ---------------------------------------
+
+    def register(self, geom_hash, cfg, profiles, noise_norm, warmup=True,
+                 scenario=None, canonical=None):
+        with self._stream_lock:
+            if self._channel is not None and canonical is not None:
+                # followers rebuild the identical geometry from the
+                # canonical spec (deterministic build_geometry) and warm
+                # the same widths — from the same persistent compilation
+                # cache
+                self._channel.broadcast({"op": "register",
+                                         "canonical": dict(canonical)})
+            super().register(geom_hash, cfg, profiles, noise_norm,
+                             warmup=warmup, scenario=scenario)
+
+    def program(self, geom_hash, width):
+        import jax
+
+        from ..runtime.programs import trace_env_key
+
+        with self._lock:
+            cfg, profiles, _ = self._geoms[geom_hash]
+            stack = self._stacks[geom_hash]
+
+        def _build():
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import OBS_AXIS
+
+            fn = build_pod_bucket_fn(cfg, profiles, stack, self._mesh)
+            w = int(width)
+            obs = NamedSharding(self._mesh, P(OBS_AXIS))
+            obs2 = NamedSharding(self._mesh, P(OBS_AXIS, None))
+            f32 = jax.ShapeDtypeStruct((w,), np.float32, sharding=obs)
+            ex = [jax.ShapeDtypeStruct((w,) + self._key_words, np.uint32,
+                                       sharding=obs2), f32, f32, f32]
+            if stack is not None:
+                ex.append(jax.ShapeDtypeStruct(
+                    (w, len(stack.param_names())), np.float32,
+                    sharding=obs2))
+            return jax.jit(fn).lower(*ex).compile()
+
+        return self._store.get_or_build(
+            (_FAMILY, geom_hash, int(width), trace_env_key()), _build)
+
+    def execute(self, geom_hash, width, keys, dms, norms, null_fracs,
+                sc=None):
+        import jax
+
+        kd = np.asarray(jax.random.key_data(keys))
+        dms = np.asarray(dms, np.float32)
+        norms = np.asarray(norms, np.float32)
+        nulls = np.asarray(null_fracs, np.float32)
+        sc = None if sc is None else np.asarray(sc, np.float32)
+        with self._stream_lock:
+            # the exec frame and its fetch exchange (inside
+            # execute_local -> device_get) are ONE frame-exchange
+            # window — nothing else may write the ctl stream in between
+            if self._channel is not None:
+                self._channel.broadcast({
+                    "op": "exec", "gh": geom_hash, "width": int(width),
+                    "kd": kd, "dms": dms, "norms": norms, "nulls": nulls,
+                    "sc": sc})
+            out = self.execute_local(geom_hash, int(width), kd, dms,
+                                     norms, nulls, sc)
+        key = (geom_hash, int(width))
+        with self._lock:
+            self.device_calls += 1
+            self._calls[key] = self._calls.get(key, 0) + 1
+        return out
+
+    def execute_local(self, geom_hash, width, kd, dms, norms, nulls, sc):
+        """One pod dispatch from already-raw inputs (the follower entry;
+        the leader's :meth:`execute` lands here after broadcasting).
+        Returns the FULL host batch (the fetch replicates)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import OBS_AXIS
+        from ..runtime.dist import device_get, put_sharded
+
+        prog = self.program(geom_hash, width)
+        obs = NamedSharding(self._mesh, P(OBS_AXIS))
+        obs2 = NamedSharding(self._mesh, P(OBS_AXIS, None))
+        args = (put_sharded(np.asarray(kd), obs2),
+                put_sharded(np.asarray(dms, np.float32), obs),
+                put_sharded(np.asarray(norms, np.float32), obs),
+                put_sharded(np.asarray(nulls, np.float32), obs))
+        if sc is not None:
+            args = args + (put_sharded(np.asarray(sc, np.float32), obs2),)
+        return device_get(prog(*args))
+
+    def shutdown_followers(self):
+        """Broadcast the clean end-of-stream (leader drain path)."""
+        with self._stream_lock:
+            if self._channel is not None:
+                self._channel.broadcast({"op": "shutdown"})
+
+    def stats(self):
+        out = super().stats()
+        out["pod"] = self._pod.describe()
+        return out
+
+
+def pod_serve_follower(widths=None, compile_cache_dir=None):
+    """A pod follower's serve loop: obey the leader's stream until
+    ``shutdown`` (clean return) — every ``exec`` joins the leader's
+    dispatch so the pod program's collectives rendezvous.  Runs until
+    the leader drains; a leader DEATH is handled by the channel
+    watchdog (loud exit), not here."""
+    from ..runtime.dist import pod_channel
+    from .spec import build_geometry, geometry_hash, scenario_stack
+
+    ch = pod_channel()
+    if ch is None:
+        raise RuntimeError("pod_serve_follower needs the pod channel "
+                           "(init_pod with channel=True)")
+    reg = PodProgramRegistry(widths, compile_cache_dir=compile_cache_dir,
+                             channel=None)
+    while True:
+        msg = ch.recv()
+        op = msg.get("op")
+        if op == "shutdown":
+            return reg
+        if op == "register":
+            canonical = msg["canonical"]
+            gh = geometry_hash(canonical)
+            if not reg.known(gh):
+                cfg, profiles, noise_norm = build_geometry(canonical)
+                reg.register(gh, cfg, profiles, noise_norm, warmup=True,
+                             scenario=scenario_stack(canonical))
+        elif op == "exec":
+            reg.execute_local(msg["gh"], msg["width"], msg["kd"],
+                              msg["dms"], msg["norms"], msg["nulls"],
+                              msg["sc"])
+        else:
+            raise RuntimeError(f"pod follower: unknown op {op!r}")

@@ -84,13 +84,16 @@ class ProgramRegistry:
             return geom_hash in self._geoms
 
     def register(self, geom_hash, cfg, profiles, noise_norm, warmup=True,
-                 scenario=None):
+                 scenario=None, canonical=None):
         """Stage one geometry bucket; with ``warmup`` (the default) every
         admitted width is AOT-compiled NOW, so the first request of this
         geometry pays zero compile on the serving path.  ``scenario``
         (a :class:`~psrsigsim_tpu.scenarios.ScenarioStack` or None) is
         part of the geometry by construction — the hash covers the spec's
-        ``scenarios`` field — and shapes the compiled program's inputs."""
+        ``scenarios`` field — and shapes the compiled program's inputs.
+        ``canonical`` (the canonical spec dict) is unused here; the pod
+        registry (:class:`psrsigsim_tpu.serve.pod.PodProgramRegistry`)
+        broadcasts it so followers rebuild the identical geometry."""
         with self._lock:
             if geom_hash not in self._geoms:
                 self._geoms[geom_hash] = (cfg, np.asarray(profiles),
@@ -168,8 +171,10 @@ class ProgramRegistry:
     # -- introspection / guards -------------------------------------------
 
     def compile_counts(self):
-        return {(g, w): c
-                for (_, g, w), c in self._store.build_counts().items()}
+        # key[1:3] = (geom_hash, width) for every serving family — the
+        # pod registry appends trace_env_key (topology) after them
+        return {(k[1], k[2]): c
+                for k, c in self._store.build_counts().items()}
 
     def call_counts(self):
         with self._lock:

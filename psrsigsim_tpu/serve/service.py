@@ -174,8 +174,22 @@ class SimulationService:
             compile_cache_dir = os.path.join(str(cache_dir), "compile_cache")
         self.replica_id = replica_id
         self.started_at = time.time()
-        self.registry = ProgramRegistry(widths,
-                                        compile_cache_dir=compile_cache_dir)
+        from ..runtime.dist import is_pod, pod_channel, pod_info
+
+        self._pod = pod_info()
+        if is_pod():
+            # pod leader: compiled programs span every host of the
+            # group; each batch broadcasts to the followers joined to
+            # this mesh (serve/pod.py) — the HTTP/cache/queue half of
+            # the service is unchanged and leader-only
+            from .pod import PodProgramRegistry
+
+            self.registry = PodProgramRegistry(
+                widths, compile_cache_dir=compile_cache_dir,
+                channel=pod_channel())
+        else:
+            self.registry = ProgramRegistry(
+                widths, compile_cache_dir=compile_cache_dir)
         self.cache = (ResultCache(cache_dir, verify=verify_cache,
                                   faults=faults,
                                   hot_max_bytes=cache_hot_bytes)
@@ -187,6 +201,11 @@ class SimulationService:
 
         self.integrity = resolve_integrity(integrity, fingerprint="serve",
                                            faults=faults)
+        if self.integrity is not None and is_pod():
+            raise RuntimeError(
+                "integrity checking is not supported on a pod serving "
+                "group yet (duplicate-execution audits break host "
+                "lockstep); arm it on single-host replicas only")
         self.max_queue = int(max_queue)
         self.batch_window_s = float(batch_window_s)
         self.retry_after_s = float(retry_after_s)
@@ -234,7 +253,8 @@ class SimulationService:
             cfg, profiles, noise_norm = build_geometry(canonical)
             self.registry.register(gh, cfg, profiles, noise_norm,
                                    warmup=True,
-                                   scenario=scenario_stack(canonical))
+                                   scenario=scenario_stack(canonical),
+                                   canonical=canonical)
         return gh
 
     def submit(self, spec, deadline_s=None):
@@ -437,6 +457,13 @@ class SimulationService:
 
     def close(self, timeout=30.0):
         ok = self.drain(timeout)
+        # a pod leader's registry holds followers blocked on its exec
+        # stream: the drain above guarantees no more dispatches, so the
+        # clean end-of-stream belongs HERE — every caller that closes
+        # the service (server shutdown, tests, embeddings) must release
+        # them, not remember to
+        if hasattr(self.registry, "shutdown_followers"):
+            self.registry.shutdown_followers()
         if self.cache is not None:
             self.cache.close()
         return ok
@@ -478,6 +505,9 @@ class SimulationService:
             "device_calls": reg["device_calls"],
             "programs": reg["programs"],
             "compile_counts": reg["compile_counts"],
+            # the multi-host group this replica leads (solo: 1 host) —
+            # the fleet's group supervision and pod-smoke gates read it
+            "pod": self._pod.describe(),
         }
         if fe is not None:
             # connection pressure for the fleet health poll and the
@@ -620,7 +650,8 @@ class SimulationService:
             self.registry.register(gh, cfg, profiles, noise_norm,
                                    warmup=True,
                                    scenario=scenario_stack(
-                                       batch[0].canonical))
+                                       batch[0].canonical),
+                                   canonical=batch[0].canonical)
         _, _, noise_norm = self.registry.geometry(gh)
         stack = self.registry.scenario_of(gh)
         width = self.registry.bucket_width(len(batch))

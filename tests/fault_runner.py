@@ -12,11 +12,23 @@ Usage::
     python tests/fault_runner.py OUT_DIR [--plan PLAN_JSON]
         [--resume-mode resume|verify] [--n-obs N] [--chunk-size N]
         [--writers N] [--obs-per-file N]
+        [--pod-hosts N --pod-host K --pod-coordinator-port P
+         --pod-channel-port Q]
 
 ``PLAN_JSON`` holds ``{"scratch_dir": ..., "spec": {...}}`` for the
 :class:`~psrsigsim_tpu.runtime.faults.FaultPlan`.  The simulation config
 is fixed (the same small fold ensemble the export tests use) so every
 invocation with the same seed generates identical data.
+
+Pod mode (``--pod-hosts`` > 1): process K of an N-host program group —
+the DEGRADED-POD proof.  The leader (K = 0) runs the normal supervised
+export over the pod-wide mesh; followers mirror its chunk loop
+(:func:`psrsigsim_tpu.io.export.pod_export_follower`).  The ``pod.kill``
+fault point (follower plans only) SIGKILLs the follower after its
+configured chunk — the leader's channel watchdog then aborts the whole
+group loudly (exit POD_PEER_EXIT, never a hang), and a clean relaunch of
+the full group resumes the journaled export to byte-identical output
+(tests/test_pod.py TestPodKill).
 """
 
 import argparse
@@ -53,6 +65,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("out_dir")
     ap.add_argument("--plan", default=None)
+    ap.add_argument("--pod-hosts", type=int, default=0,
+                    help="size of the multi-host program group (0/1 = "
+                         "the single-process pre-pod path)")
+    ap.add_argument("--pod-host", type=int, default=0)
+    ap.add_argument("--pod-coordinator-port", type=int, default=None)
+    ap.add_argument("--pod-channel-port", type=int, default=None)
     ap.add_argument("--resume-mode", default="resume",
                     choices=["resume", "verify"])
     ap.add_argument("--n-obs", type=int, default=5)
@@ -74,6 +92,14 @@ def main(argv=None):
                          "export (quarantining bit-rot) and report it")
     args = ap.parse_args(argv)
 
+    if args.pod_hosts and args.pod_hosts > 1:
+        # pod bootstrap precedes the first jax computation
+        from psrsigsim_tpu.runtime.dist import init_pod
+
+        init_pod(coordinator=f"127.0.0.1:{args.pod_coordinator_port}",
+                 num_processes=args.pod_hosts, process_id=args.pod_host,
+                 channel_port=args.pod_channel_port)
+
     import jax
 
     jax.config.update("jax_enable_x64", False)
@@ -93,10 +119,42 @@ def main(argv=None):
     dms = None
     if args.hetero_run_len > 0:
         # deterministic pulsar-major DM runs: identical across the
-        # killed run and its resume, so grouping (and bytes) reproduce
+        # killed run and its resume (and across pod group members), so
+        # grouping (and bytes) reproduce
         import numpy as np
 
         dms = 10.0 + 5.0 * (np.arange(args.n_obs) // args.hetero_run_len)
+
+    if args.pod_hosts and args.pod_hosts > 1 and args.pod_host > 0:
+        # follower: mirror the leader's chunk loop (same skips, same
+        # dispatches, same fetches — the collectives rendezvous); the
+        # pod.kill point models a host dying mid-run
+        from psrsigsim_tpu.io.export import pod_export_follower
+        from psrsigsim_tpu.runtime.dist import shutdown_pod
+        from psrsigsim_tpu.runtime.faults import crash_process
+
+        chunks_done = [0]
+
+        def _progress(done, total):
+            chunks_done[0] += 1
+            if plan is not None:
+                cfg = plan.config("pod.kill")
+                if cfg is not None and chunks_done[0] >= int(
+                        cfg.get("after_chunks", 1)):
+                    if plan.fire("pod.kill",
+                                 token=f"chunk={chunks_done[0]}"):
+                        crash_process()
+
+        pod_export_follower(
+            ens, args.n_obs, args.out_dir, seed=SEED, dms=dms,
+            chunk_size=args.chunk_size,
+            obs_per_file=args.obs_per_file,
+            resume=args.resume_mode in ("resume", "verify"),
+            verify=args.resume_mode == "verify",
+            pipeline_depth=args.pipeline_depth, progress=_progress)
+        shutdown_pod()
+        print(json.dumps({"pod_follower": args.pod_host, "ok": True}))
+        return 0
     res = supervised_export(
         ens, args.n_obs, args.out_dir, TEMPLATE, ens.pulsar, seed=SEED,
         chunk_size=args.chunk_size, writers=args.writers, dms=dms,
@@ -110,6 +168,11 @@ def main(argv=None):
         from psrsigsim_tpu.runtime import scrub_export_dir
 
         out["scrub"] = scrub_export_dir(args.out_dir)
+    if args.pod_hosts and args.pod_hosts > 1:
+        from psrsigsim_tpu.runtime.dist import pod_info, shutdown_pod
+
+        out["pod"] = pod_info().describe()
+        shutdown_pod()
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,518 @@
+"""Multi-process pod driver: host-count bit-identity, warm-join, and
+scaling proofs for the jax.distributed layer (tests/test_pod.py,
+``bench.py --pod-smoke``, config15_pod).
+
+Each proof spawns N worker processes forming a local CPU pod cluster —
+``jax.distributed.initialize`` against a loopback coordinator, the
+fleet_runner subprocess pattern — with the GLOBAL device count held
+constant (8 virtual CPU devices split ``8 / N`` per host), so the pod
+analogue of the chunk-size invariance is testable: the same global mesh
+at host counts {1, 2, 4} must produce bit-identical bytes from every
+program family.  One JSON verdict line on stdout per mode:
+
+``--mode identity``
+    For each host count in ``--hosts``: run the requested ``--families``
+    (ensemble float + packed-quantized, the Monte-Carlo study engine,
+    the dataset record sampler, and the serving engine behind
+    ``SimulationService``) on a pod of that size, sha256 every fetched
+    result, and assert the hashes agree across ALL host counts — and
+    that the single-process run (jax.distributed uninitialized) produced
+    them through the byte-identical pre-pod code path.
+
+``--mode warm``
+    The shared-cache warm-start gate: one pod run populates a persistent
+    compilation cache; a SECOND run (fresh processes — "a host joins")
+    over the same cache dir must add ZERO new cache entries for the
+    already-built (geometry, width, mesh) keys.
+
+``--mode bench``
+    config15_pod: per-host and aggregate quantized-ensemble obs/s at a
+    FIXED devices-per-host (the scaling axis: more hosts = more
+    devices), with compile counts — the numbers the MULTICHIP records
+    exist to hold.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: the tiny fixed workload IS fault_runner's geometry — imported, not
+#: copied, so the cross-harness byte-identity proofs (pod_smoke drives
+#: both) can never silently drift onto different workloads
+from fault_runner import SIM_CONFIG  # noqa: E402
+
+SEED = 3
+N_OBS = 8
+MC_TRIALS = 16
+MC_PRIORS = {"dm": {"dist": "uniform", "lo": 9.0, "hi": 11.0},
+             "noise_scale": {"dist": "loguniform", "lo": 0.5, "hi": 2.0}}
+DATASET_SPEC = {
+    "nchan": 4, "fcent_mhz": 1380.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "tobs_s": 0.02, "period_s": 0.005,
+    "smean_jy": 0.05, "seed": 11, "n_records": 8, "shards": 2,
+    "dm": 10.0, "scenarios": ["rfi"], "rfi_imp_prob": 0.25,
+    "rfi_nb_prob": 0.25,
+    "priors": {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0}},
+}
+SERVE_SPEC = {
+    "nchan": 4, "fcent_mhz": 1400.0, "bw_mhz": 400.0,
+    "sample_rate_mhz": 0.2048, "sublen_s": 0.5, "tobs_s": 1.0,
+    "period_s": 0.005, "smean_jy": 0.05, "seed": 3, "dm": 10.0,
+}
+N_SERVE = 3
+ALL_FAMILIES = ("ensemble", "mc", "dataset", "serve")
+
+
+FAULT_RUNNER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "fault_runner.py")
+
+
+def _free_port_pair():
+    from psrsigsim_tpu.runtime.dist import free_ports
+
+    return free_ports(2)
+
+
+def spawn_fault_group(out_dir, n_hosts, n_obs, chunk, follower_plan=None,
+                      timeout=540, extra=()):
+    """One fault_runner export program group: leader (pod host 0) runs
+    the supervised export, followers mirror its chunk loop.  Global
+    device count held at 8 (8 // n_hosts per host).  The SHARED spawner
+    for every harness that proves export-group behavior (tests/test_pod
+    and bench.py pod_smoke) — one place stages the pod env/flags, so the
+    proofs cannot silently drift onto different topologies.  Returns
+    ``[(returncode, stdout, stderr), ...]`` leader first — bounded by
+    ``timeout``, so a wedged collective fails the caller instead of
+    hanging it."""
+    coord, chan = _free_port_pair()
+    procs = []
+    for pid in range(n_hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("PSS_TEST_PLATFORM", "cpu")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={8 // n_hosts}")
+        cmd = [sys.executable, FAULT_RUNNER, out_dir,
+               "--n-obs", str(n_obs), "--chunk-size", str(chunk)]
+        cmd += list(extra)
+        if n_hosts > 1:
+            cmd += ["--pod-hosts", str(n_hosts), "--pod-host", str(pid),
+                    "--pod-coordinator-port", str(coord),
+                    "--pod-channel-port", str(chan)]
+        if follower_plan is not None and pid > 0:
+            cmd += ["--plan", follower_plan]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True,
+                                      env=env))
+    done = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            raise
+        done.append((p.returncode, out, err))
+    return done
+
+
+def _sha(*arrays):
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# worker: one pod process
+# ---------------------------------------------------------------------------
+
+
+def run_worker(args):
+    # env (JAX_PLATFORMS / XLA_FLAGS / PSS_POD_*) was staged by the
+    # spawner BEFORE this process started; the pod must bootstrap before
+    # the first jax computation.  SIGUSR1 dumps all thread stacks — the
+    # first question about any wedged pod is "who is blocked where"
+    import faulthandler
+    import signal as _signal
+
+    faulthandler.register(_signal.SIGUSR1, all_threads=True)
+    from psrsigsim_tpu.runtime.dist import (device_get, init_pod,
+                                            pod_channel, shutdown_pod)
+
+    info = init_pod()
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_enable_x64", False)
+
+    families = args.families.split(",")
+    out = {"process_id": info.process_id,
+           "num_processes": info.num_processes,
+           "n_global_devices": len(jax.devices()),
+           "n_local_devices": len(jax.local_devices()),
+           "is_pod": info.is_pod, "hashes": {}, "timings": {}}
+
+    if args.compile_cache_dir:
+        from psrsigsim_tpu.runtime.programs import enable_compilation_cache
+
+        out["cache_enabled"] = enable_compilation_cache(
+            args.compile_cache_dir)
+
+    from psrsigsim_tpu.simulate import Simulation
+
+    sim = Simulation(psrdict=dict(SIM_CONFIG))
+    sim.init_all()
+
+    if "ensemble" in families:
+        ens = sim.to_ensemble()
+        t0 = time.perf_counter()
+        flo = device_get(ens.run(N_OBS, seed=SEED))
+        data, scl, offs = (device_get(a) for a in
+                           ens.run_quantized(N_OBS, seed=SEED))
+        out["timings"]["ensemble_s"] = round(time.perf_counter() - t0, 3)
+        # ADVISORY, not gated: the one-shot float block is subject to
+        # the documented backend-FFT last-ulp caveat when the compiled
+        # program SHAPE changes (run_quantized docstring) — a pod mesh
+        # is a different executable, and on this stack it moves ~4 ulps
+        # in a few percent of samples vs the single-host program.  The
+        # shipped products (packed export stream, MC metrics, dataset
+        # records, served profiles) are pinned bit-identical below.
+        out["advisory"] = {"ensemble_float": _sha(flo)}
+        out["hashes"]["ensemble_quantized"] = _sha(data, scl, offs)
+        # the streaming chunked path (the export family's program)
+        blocks = [b for _, b in ens.iter_chunks(
+            N_OBS, chunk_size=4, seed=SEED, quantized=True,
+            byte_order="big", finite_mask=True)]
+        out["hashes"]["ensemble_chunks"] = _sha(
+            *[a for b in blocks for a in b])
+
+    if "mc" in families:
+        from psrsigsim_tpu.mc import MonteCarloStudy
+
+        study = MonteCarloStudy.from_simulation(sim, MC_PRIORS, seed=SEED)
+        t0 = time.perf_counter()
+        res = study.run(MC_TRIALS, chunk_size=8, out_dir=None)
+        out["timings"]["mc_s"] = round(time.perf_counter() - t0, 3)
+        out["hashes"]["mc_metrics"] = _sha(res.metrics)
+        out["hashes"]["mc_hist"] = _sha(res.hist)
+
+    if "dataset" in families:
+        from psrsigsim_tpu.datasets.sampler import RecordSampler
+        from psrsigsim_tpu.datasets.spec import canonicalize
+
+        sampler = RecordSampler(canonicalize(dict(DATASET_SPEC)))
+        width = sampler.chunk_width(8)
+        t0 = time.perf_counter()
+        host = device_get(sampler.dispatch(0, width))
+        out["timings"]["dataset_s"] = round(time.perf_counter() - t0, 3)
+        out["hashes"]["dataset_records"] = _sha(*host)
+
+    if "serve" in families:
+        t0 = time.perf_counter()
+        if info.is_pod and not info.is_leader:
+            from psrsigsim_tpu.serve.pod import pod_serve_follower
+
+            pod_serve_follower(widths=(1, 8))
+        else:
+            from psrsigsim_tpu.serve.service import SimulationService
+
+            service = SimulationService(cache_dir=None, widths=(1, 8),
+                                        batch_window_s=0.001)
+            shas = []
+            for i in range(N_SERVE):
+                spec = dict(SERVE_SPEC, seed=300 + i, dm=10.0 + 0.25 * i)
+                rid, _ = service.submit(spec, deadline_s=120.0)
+                shas.append(_sha(service.result(rid, timeout=120.0)))
+            service.close()   # pod leader: also drains the followers
+            out["hashes"]["serve_profiles"] = _sha(
+                "|".join(shas).encode())
+        out["timings"]["serve_s"] = round(time.perf_counter() - t0, 3)
+
+    from psrsigsim_tpu.runtime.programs import global_registry
+
+    snap = global_registry().snapshot()
+    out["program_builds"] = snap["builds_by_family"]
+    # leaders speak the verdict; followers confirm lockstep completion
+    if pod_channel() is not None:
+        pod_channel().barrier("worker-done")
+    shutdown_pod()
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# spawner helpers
+# ---------------------------------------------------------------------------
+
+
+def _spawn_pod(n_hosts, devices_per_host, worker_argv, timeout=600.0):
+    """One pod run: N worker processes (each running this script with
+    ``worker_argv``), global device count = n_hosts * devices_per_host.
+    The ONE place that stages the pod bootstrap env (PSS_POD_* /
+    XLA_FLAGS) — every proof mode spawns through here so they all test
+    the same topology.  Returns the per-process verdict dicts (leader
+    first)."""
+    port, chan = _free_port_pair()
+    procs = []
+    for pid in range(n_hosts):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("PSS_TEST_PLATFORM", "cpu")
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_host}")
+        if n_hosts > 1:
+            env["PSS_POD_COORDINATOR"] = f"127.0.0.1:{port}"
+            env["PSS_POD_NUM_PROCESSES"] = str(n_hosts)
+            env["PSS_POD_PROCESS_ID"] = str(pid)
+            env["PSS_POD_CHANNEL_PORT"] = str(chan)
+        else:
+            for k in ("PSS_POD_COORDINATOR", "PSS_POD_NUM_PROCESSES",
+                      "PSS_POD_PROCESS_ID", "PSS_POD_CHANNEL_PORT"):
+                env.pop(k, None)
+        cmd = [sys.executable, os.path.abspath(__file__)] + list(worker_argv)
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True,
+                                      env=env))
+    outs = []
+    deadline = time.time() + timeout
+    for p in procs:
+        out, err = p.communicate(timeout=max(5.0, deadline - time.time()))
+        if p.returncode != 0:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            raise RuntimeError(
+                f"pod worker rc={p.returncode}: {err[-2000:]}")
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    outs.sort(key=lambda o: o["process_id"])
+    return outs
+
+
+def _worker_argv(families, compile_cache_dir=None):
+    argv = ["--mode", "worker", "--families", families]
+    if compile_cache_dir:
+        argv += ["--compile-cache-dir", compile_cache_dir]
+    return argv
+
+
+def _leader_hashes(outs):
+    merged = {}
+    for o in outs:
+        merged.update(o.get("hashes", {}))
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# proofs
+# ---------------------------------------------------------------------------
+
+
+def run_identity(args):
+    """Bit-identity across host counts at a CONSTANT global device
+    count — the pod analogue of chunk-size invariance."""
+    hosts = [int(h) for h in args.hosts.split(",")]
+    total = args.total_devices
+    for h in hosts:
+        if total % h:
+            raise SystemExit(f"--total-devices {total} must divide by "
+                             f"host count {h}")
+    runs = {}
+    timings = {}
+    for h in hosts:
+        outs = _spawn_pod(h, total // h, _worker_argv(args.families))
+        runs[h] = _leader_hashes(outs)
+        timings[h] = outs[0].get("timings", {})
+        assert outs[0]["n_global_devices"] == total, outs[0]
+        assert outs[0]["is_pod"] == (h > 1)
+    base = runs[hosts[0]]
+    mism = {}
+    for h in hosts[1:]:
+        for k, v in runs[h].items():
+            if base.get(k) != v:
+                mism[f"hosts{h}/{k}"] = [base.get(k), v]
+    verdict = {
+        "mode": "identity", "hosts": hosts, "total_devices": total,
+        "families": args.families.split(","),
+        "hashes": base, "mismatches": mism, "timings": timings,
+        "ok": not mism and all(len(r) == len(base) for r in runs.values()),
+    }
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+def run_warm(args):
+    """Warm-join: a second (fresh-process) pod over an already-populated
+    compilation cache compiles ZERO new programs."""
+    import glob
+    import tempfile
+
+    cache = args.cache_dir or tempfile.mkdtemp(prefix="pss_pod_cc_")
+    os.makedirs(cache, exist_ok=True)
+
+    def census():
+        return sorted(os.path.basename(p)
+                      for p in glob.glob(os.path.join(cache, "**", "*"),
+                                         recursive=True)
+                      if os.path.isfile(p))
+
+    n_hosts = args.warm_hosts
+    cold = _spawn_pod(n_hosts, args.total_devices // n_hosts,
+                      _worker_argv(args.families, compile_cache_dir=cache))
+    files_cold = census()
+    t_cold = cold[0].get("timings", {})
+    warm = _spawn_pod(n_hosts, args.total_devices // n_hosts,
+                      _worker_argv(args.families, compile_cache_dir=cache))
+    files_warm = census()
+    t_warm = warm[0].get("timings", {})
+    new_entries = sorted(set(files_warm) - set(files_cold))
+    verdict = {
+        "mode": "warm", "hosts": n_hosts, "cache_dir": cache,
+        "cache_entries_cold": len(files_cold),
+        "cache_entries_warm": len(files_warm),
+        "new_entries_on_join": len(new_entries),
+        "hashes_equal": _leader_hashes(cold) == _leader_hashes(warm),
+        "timings_cold": t_cold, "timings_warm": t_warm,
+        "cache_enabled": bool(cold[0].get("cache_enabled")),
+        "ok": (not new_entries and len(files_cold) > 0
+               and bool(cold[0].get("cache_enabled"))
+               and _leader_hashes(cold) == _leader_hashes(warm)),
+    }
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+def run_bench_worker(args):
+    """One bench worker: timed quantized-ensemble chunks over the pod
+    mesh (per-host wall time; the leader aggregates)."""
+    from psrsigsim_tpu.runtime.dist import (init_pod, pod_channel,
+                                            shutdown_pod)
+
+    info = init_pod()
+    import jax
+
+    jax.config.update("jax_enable_x64", False)
+
+    from psrsigsim_tpu.simulate import Simulation
+
+    sim = Simulation(psrdict=dict(SIM_CONFIG))
+    sim.init_all()
+    ens = sim.to_ensemble()
+    n_obs = args.bench_obs
+    chunk = args.bench_chunk
+    # warmup chunk (compile), then the timed pass
+    for _ in ens.iter_chunks(chunk, chunk_size=chunk, seed=SEED,
+                             quantized=True, byte_order="big"):
+        pass
+    if pod_channel() is not None:
+        pod_channel().barrier("bench-warm")
+    from psrsigsim_tpu.runtime.telemetry import StageTimers
+
+    timers = StageTimers()
+    t0 = time.perf_counter()
+    n = 0
+    for _, block in ens.iter_chunks(n_obs, chunk_size=chunk, seed=SEED,
+                                    quantized=True, byte_order="big",
+                                    timers=timers):
+        n += block[0].shape[0]
+    dt = time.perf_counter() - t0
+    if pod_channel() is not None:
+        pod_channel().barrier("bench-done")
+    from psrsigsim_tpu.runtime.programs import global_registry
+
+    snap = timers.snapshot()
+    out = {"process_id": info.process_id,
+           "num_processes": info.num_processes,
+           "n_global_devices": len(jax.devices()),
+           "obs": n, "wall_s": round(dt, 4),
+           "obs_per_sec": round(n / dt, 2),
+           "stage_timers": {k: snap[k] for k in
+                            ("dispatch_s", "fetch_s", "bytes_fetched",
+                             "bottleneck") if k in snap},
+           # 0 after the loop proves every dispatched buffer was drained
+           "live_buffer_bytes_final": snap.get("live_buffer_bytes_gauge", 0),
+           "program_builds": global_registry().snapshot()
+           ["builds_by_family"]}
+    shutdown_pod()
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def run_bench(args):
+    """config15_pod: aggregate obs/s at host counts from --hosts with a
+    FIXED devices-per-host (adding hosts adds devices)."""
+    hosts = [int(h) for h in args.hosts.split(",")]
+    levels = {}
+    for h in hosts:
+        outs = _spawn_pod(h, args.devices_per_host,
+                          ["--mode", "bench-worker",
+                           "--bench-obs", str(args.bench_obs),
+                           "--bench-chunk", str(args.bench_chunk)])
+        agg = outs[0]["obs"] / max(o["wall_s"] for o in outs)
+        levels[str(h)] = {
+            "devices": outs[0]["n_global_devices"],
+            "per_host_obs_per_sec": [o["obs_per_sec"] for o in outs],
+            "aggregate_obs_per_sec": round(agg, 2),
+            "stage_timers": outs[0].get("stage_timers", {}),
+            "live_buffer_bytes_final": outs[0].get(
+                "live_buffer_bytes_final", 0),
+            "program_builds": outs[0]["program_builds"],
+        }
+    h0 = str(hosts[0])
+    base = levels[h0]["aggregate_obs_per_sec"]
+    for h in hosts:
+        lv = levels[str(h)]
+        ratio = lv["aggregate_obs_per_sec"] / base if base else 0.0
+        lv["speedup_vs_1host"] = round(ratio, 3)
+        lv["scaling_efficiency"] = round(ratio / (h / hosts[0]), 3)
+    verdict = {"mode": "bench", "hosts": hosts,
+               "devices_per_host": args.devices_per_host,
+               "bench_obs": args.bench_obs, "levels": levels, "ok": True}
+    print(json.dumps(verdict), flush=True)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", required=True,
+                    choices=["worker", "identity", "warm", "bench",
+                             "bench-worker"])
+    ap.add_argument("--hosts", default="1,2",
+                    help="comma-separated host counts to compare")
+    ap.add_argument("--total-devices", type=int, default=8,
+                    help="CONSTANT global device count for identity "
+                         "runs (split across hosts)")
+    ap.add_argument("--devices-per-host", type=int, default=4,
+                    help="bench mode: fixed per-host devices (adding "
+                         "hosts adds devices)")
+    ap.add_argument("--families", default=",".join(ALL_FAMILIES))
+    ap.add_argument("--cache-dir", default=None)
+    ap.add_argument("--compile-cache-dir", default=None)
+    ap.add_argument("--warm-hosts", type=int, default=2)
+    ap.add_argument("--bench-obs", type=int, default=64)
+    ap.add_argument("--bench-chunk", type=int, default=16)
+    args = ap.parse_args(argv)
+    if args.mode == "worker":
+        return run_worker(args)
+    if args.mode == "identity":
+        return run_identity(args)
+    if args.mode == "warm":
+        return run_warm(args)
+    if args.mode == "bench-worker":
+        return run_bench_worker(args)
+    return run_bench(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,508 @@
+"""Pod-scale execution tests (runtime/dist.py + the pod adoption).
+
+Four layers, cheapest first:
+
+* the dist primitives' single-process fallback is EXACTLY the plain jax
+  call (the byte-identical pre-pod contract);
+* the registry/cache key audit across a SIMULATED 2-process topology
+  (program keys and the persistent-cache path must fork on topology,
+  process-id-independently — no real cluster needed to pin the keys);
+* buffer-donation byte-identity: the chunked hot-loop programs built
+  with ``PSS_DONATE=1`` produce bit-identical results to ``PSS_DONATE=0``
+  builds (donation is an aliasing hint, never a value change);
+* the real thing: a multi-process CPU pod cluster
+  (tests/pod_runner.py) proving host-count bit-identity {1, 2, 4} for
+  the ensemble/MC/dataset/serve program families at a constant global
+  device count — the pod analogue of the chunk-size invariance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from psrsigsim_tpu.runtime import dist
+from psrsigsim_tpu.runtime.programs import (ProgramRegistry,
+                                            donation_enabled,
+                                            trace_env_key)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+POD_RUNNER = os.path.join(REPO, "tests", "pod_runner.py")
+
+#: the workload dicts are imported from the subprocess harness (which
+#: itself imports SIM_CONFIG from fault_runner), so the in-process
+#: pins and the cluster proofs exercise the SAME geometry by
+#: construction — a drifted copy would weaken the identity gates
+#: without failing anything
+from pod_runner import (SERVE_SPEC, SIM_CONFIG,  # noqa: E402
+                        spawn_fault_group)
+
+
+@pytest.fixture
+def fake_pod():
+    """Install a simulated pod topology; always restore the real one."""
+    installed = []
+
+    def _install(num_processes, process_id=0):
+        prev = dist.fake_pod_for_tests(num_processes,
+                                       process_id=process_id)
+        installed.append(prev)
+        return dist.pod_info()
+
+    yield _install
+    for prev in reversed(installed):
+        dist._pod = prev
+
+
+class TestSoloFallback:
+    """Unconfigured, every dist helper IS the plain jax call."""
+
+    def test_init_pod_unconfigured_is_noop(self, monkeypatch):
+        for k in ("PSS_POD_COORDINATOR", "PSS_POD_NUM_PROCESSES",
+                  "PSS_POD_PROCESS_ID"):
+            monkeypatch.delenv(k, raising=False)
+        prev = dist._pod
+        try:
+            dist._pod = dist._SOLO
+            info = dist.init_pod()
+            assert info.initialized and not info.is_pod
+            assert info.is_leader and info.num_processes == 1
+        finally:
+            dist._pod = prev
+
+    def test_put_sharded_matches_device_put(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from psrsigsim_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        x = np.arange(16, dtype=np.float32)
+        sh = NamedSharding(mesh, P("obs"))
+        a = dist.put_sharded(x, sh)
+        b = jax.device_put(x, sh)
+        assert a.sharding == b.sharding
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # typed keys stage too (the staging path device_put refuses on
+        # real multi-host shardings)
+        keys = jax.vmap(jax.random.key)(np.arange(16, dtype=np.uint32))
+        k = dist.put_sharded(keys, sh)
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(k)),
+            np.asarray(jax.random.key_data(keys)))
+
+    def test_device_get_matches_jax(self):
+        tree = {"a": jax.numpy.arange(8), "b": (jax.numpy.ones(3), 2.5)}
+        got = dist.device_get(tree)
+        want = jax.device_get(tree)
+        np.testing.assert_array_equal(got["a"], want["a"])
+        np.testing.assert_array_equal(got["b"][0], want["b"][0])
+
+    def test_solo_keys_and_cache_path(self):
+        assert dist.pod_key() == ("solo",)
+        assert dist.compile_cache_path("/tmp/cc") == "/tmp/cc"
+        assert dist.is_leader()
+
+
+class TestTopologyKeyAudit:
+    """The registry/cache key audit across a simulated 2-process
+    topology: a cached single-host program can never be served to a pod
+    mesh, and every process of one pod resolves identical keys."""
+
+    def test_pod_key_forks_and_is_process_id_independent(self, fake_pod):
+        solo = dist.pod_key()
+        fake_pod(2, process_id=0)
+        k0 = dist.pod_key()
+        fake_pod(2, process_id=1)
+        k1 = dist.pod_key()
+        assert k0 == k1 == ("pod", 2)
+        assert k0 != solo
+
+    def test_trace_env_key_covers_topology(self, fake_pod):
+        base = trace_env_key()
+        fake_pod(2)
+        assert trace_env_key() != base
+
+    def test_compile_cache_path_forks_per_host_count(self, fake_pod):
+        assert dist.compile_cache_path("/x") == "/x"
+        fake_pod(2)
+        assert dist.compile_cache_path("/x") == os.path.join("/x",
+                                                             "hosts2")
+        fake_pod(4)
+        assert dist.compile_cache_path("/x") == os.path.join("/x",
+                                                             "hosts4")
+
+    def test_assert_single_build_across_topologies(self, fake_pod):
+        """One geometry, two topologies: TWO registry artifacts, each
+        built exactly once — the solo build is never served to the
+        simulated pod."""
+        reg = ProgramRegistry("audit")
+        built = []
+
+        def make(tag):
+            def _build():
+                built.append(tag)
+                return tag
+            return _build
+
+        key_solo = ("fam", "geom", trace_env_key())
+        a = reg.get_or_build(key_solo, make("solo"))
+        fake_pod(2)
+        key_pod = ("fam", "geom", trace_env_key())
+        assert key_pod != key_solo
+        assert reg.peek(key_pod) is None   # never cross-served
+        b = reg.get_or_build(key_pod, make("pod2"))
+        assert (a, b) == ("solo", "pod2") and built == ["solo", "pod2"]
+        reg.assert_single_build()
+
+    def test_follower_refuses_leader_only_paths(self, fake_pod):
+        fake_pod(2, process_id=1)
+        assert not dist.is_leader()
+        from psrsigsim_tpu.io.export import export_ensemble_psrfits
+
+        with pytest.raises(RuntimeError, match="pod_export_follower"):
+            export_ensemble_psrfits(object(), 4, "/tmp/never", "t", None)
+
+
+class TestChannelHello:
+    """The channel bootstrap's authenticated hello: a connection that
+    cannot prove the shared secret never fills a follower slot (the
+    pre-auth surface reads NO pickle, so a crafted payload is inert),
+    while a properly authenticated pair bootstraps."""
+
+    def _leader(self, info, port, timeout_s):
+        import threading
+
+        box = {}
+
+        def _run():
+            try:
+                box["ch"] = dist.PodChannel(info, port,
+                                            timeout_s=timeout_s)
+            except Exception as exc:  # noqa: BLE001 — assert on it below
+                box["err"] = exc
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        return t, box
+
+    def test_bad_hello_never_fills_a_slot(self):
+        import socket
+        import time as _time
+
+        info = dist.PodInfo(process_id=0, num_processes=2,
+                            coordinator="127.0.0.1:0", initialized=True)
+        (port,) = dist.free_ports(1)
+        t, box = self._leader(info, port, timeout_s=2.5)
+        deadline = _time.time() + 2.0
+        sent = False
+        while not sent and _time.time() < deadline:
+            try:
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=1.0)
+                # a forged hello: right size, wrong MAC (e.g. a pickle
+                # bomb would land here — it is never unpickled)
+                s.sendall(b"c" + b"\x00" * (dist._HELLO.size - 1
+                                            + dist._HELLO_MAC))
+                sent = True
+                s.close()
+            except OSError:
+                _time.sleep(0.05)
+        t.join(timeout=10.0)
+        assert sent and "ch" not in box
+        assert isinstance(box.get("err"), TimeoutError)
+
+    def test_authenticated_pair_bootstraps(self):
+        leader_info = dist.PodInfo(process_id=0, num_processes=2,
+                                   coordinator="127.0.0.1:0",
+                                   initialized=True)
+        follower_info = dist.PodInfo(process_id=1, num_processes=2,
+                                     coordinator="127.0.0.1:0",
+                                     initialized=True)
+        (port,) = dist.free_ports(1)
+        t, box = self._leader(leader_info, port, timeout_s=10.0)
+        fch = dist.PodChannel(follower_info, port, timeout_s=10.0,
+                              on_peer_lost=lambda pid: None)
+        t.join(timeout=10.0)
+        lch = box.get("ch")
+        assert lch is not None, box.get("err")
+        try:
+            lch.broadcast(("hello", 1))
+            assert fch.recv() == ("hello", 1)
+            fch.send_to_leader(("ack", 1))
+            assert lch.gather() == {1: ("ack", 1)}
+        finally:
+            lch._on_peer_lost = lambda pid: None
+            for ch in (fch, lch):
+                ch.close()
+
+
+class TestDonationByteIdentity:
+    """PSS_DONATE on vs off: identical bytes from the donated chunked
+    hot loops (ensemble packed / MC trials / dataset records) — the
+    donation satellite's pin.  trace_env_key covers the flag, so the
+    two builds resolve distinct registry keys in one process."""
+
+    @pytest.fixture(scope="class")
+    def sim(self):
+        from psrsigsim_tpu.simulate import Simulation
+
+        sim = Simulation(psrdict=dict(SIM_CONFIG))
+        sim.init_all()
+        return sim
+
+    def _ens_bytes(self, sim):
+        ens = sim.to_ensemble()
+        data, scl, offs, finite = ens.run_quantized(8, seed=3,
+                                                    return_finite=True)
+        blocks = [b for _, b in ens.iter_chunks(
+            8, chunk_size=4, seed=3, quantized=True, byte_order="big")]
+        return (np.asarray(data).tobytes() + np.asarray(scl).tobytes()
+                + np.asarray(offs).tobytes()
+                + b"".join(np.asarray(a).tobytes()
+                           for b in blocks for a in b))
+
+    def test_donation_flag_parses(self, monkeypatch):
+        monkeypatch.setenv("PSS_DONATE", "1")
+        assert donation_enabled() is True
+        monkeypatch.setenv("PSS_DONATE", "0")
+        assert donation_enabled() is False
+        monkeypatch.setenv("PSS_DONATE", "nope")
+        with pytest.raises(ValueError):
+            donation_enabled()
+
+    def test_ensemble_packed(self, sim, monkeypatch):
+        monkeypatch.setenv("PSS_DONATE", "0")
+        off = self._ens_bytes(sim)
+        monkeypatch.setenv("PSS_DONATE", "1")
+        on = self._ens_bytes(sim)
+        assert off == on
+
+    def test_mc_trials(self, sim, monkeypatch):
+        from psrsigsim_tpu.mc import MonteCarloStudy
+
+        priors = {"dm": {"dist": "uniform", "lo": 9.0, "hi": 11.0}}
+
+        def run():
+            study = MonteCarloStudy.from_simulation(sim, priors, seed=3)
+            return study.run(16, chunk_size=8, out_dir=None)
+
+        monkeypatch.setenv("PSS_DONATE", "0")
+        off = run()
+        monkeypatch.setenv("PSS_DONATE", "1")
+        on = run()
+        np.testing.assert_array_equal(off.metrics, on.metrics)
+        np.testing.assert_array_equal(off.hist, on.hist)
+
+    def test_dataset_records(self, monkeypatch):
+        from psrsigsim_tpu.datasets.sampler import RecordSampler
+        from psrsigsim_tpu.datasets.spec import canonicalize
+
+        spec = {
+            "nchan": 4, "fcent_mhz": 1380.0, "bw_mhz": 400.0,
+            "sample_rate_mhz": 0.2048, "tobs_s": 0.02, "period_s": 0.005,
+            "smean_jy": 0.05, "seed": 11, "n_records": 8, "shards": 2,
+            "dm": 10.0,
+            "priors": {"dm": {"dist": "uniform", "lo": 5.0, "hi": 20.0}},
+        }
+
+        def record():
+            return RecordSampler(canonicalize(dict(spec))).record_host(3)
+
+        monkeypatch.setenv("PSS_DONATE", "0")
+        off = record()
+        monkeypatch.setenv("PSS_DONATE", "1")
+        on = record()
+        assert sorted(off) == sorted(on)
+        for k in off:
+            np.testing.assert_array_equal(off[k], on[k])
+
+    def test_live_buffer_gauge_reported(self, sim):
+        from psrsigsim_tpu.runtime import StageTimers
+
+        timers = StageTimers()
+        ens = sim.to_ensemble()
+        for _ in ens.iter_chunks(8, chunk_size=4, seed=3, quantized=True,
+                                 byte_order="big", timers=timers):
+            pass
+        snap = timers.snapshot()
+        assert "live_buffer_bytes_gauge" in snap
+        assert snap["live_buffer_bytes_gauge"] == 0  # drained
+
+
+#: one shared spawner (tests/pod_runner.py) stages the pod env/flags
+#: for every export-group proof — see spawn_fault_group
+_spawn_export_group = spawn_fault_group
+
+
+def _fits_bytes(out_dir):
+    import glob
+
+    out = {}
+    for p in sorted(glob.glob(os.path.join(out_dir, "*.fits"))):
+        with open(p, "rb") as f:
+            out[os.path.basename(p)] = f.read()
+    return out
+
+
+@pytest.mark.faults
+class TestPodKill:
+    """Degraded pods: a follower SIGKILL'd mid-run surfaces as a LOUD
+    whole-group abort the supervising layer restarts (exit
+    POD_PEER_EXIT — never a hang in a wedged collective), and a clean
+    relaunch of the full group resumes to byte-identical output."""
+
+    N_OBS, CHUNK = 12, 4
+
+    def test_follower_death_aborts_group_and_resume_is_byte_identical(
+            self, tmp_path):
+        from psrsigsim_tpu.runtime.dist import POD_PEER_EXIT
+
+        # the uninterrupted solo reference every pod byte is pinned to
+        solo = str(tmp_path / "solo")
+        (rc, _, err), = _spawn_export_group(solo, 1, self.N_OBS,
+                                            self.CHUNK)
+        assert rc == 0, err[-3000:]
+        want = _fits_bytes(solo)
+        assert len(want) == self.N_OBS
+
+        # arm pod.kill on the follower: SIGKILL after its first chunk
+        plan = str(tmp_path / "podkill.json")
+        with open(plan, "w") as f:
+            json.dump({"scratch_dir": str(tmp_path / "podkill_scratch"),
+                       "spec": {"pod.kill": {"after_chunks": 1}}}, f)
+        out = str(tmp_path / "pod")
+        # depth 0 makes the mid-run state deterministic: every chunk
+        # fetch is a strict leader/follower rendezvous, so the leader
+        # can never be fed past the follower's death point (at depth
+        # >0 the dispatch-ahead window can hand the leader every chunk
+        # before the kill lands); the resume below runs at the default
+        # depth, which also exercises cross-depth resume identity
+        results = _spawn_export_group(out, 2, self.N_OBS, self.CHUNK,
+                                      follower_plan=plan,
+                                      extra=("--pipeline-depth", "0"))
+        (lead_rc, _, lead_err), (fol_rc, _, _) = results
+        # the follower died by SIGKILL; the leader noticed over the
+        # channel watchdog and aborted the whole group loudly
+        assert fol_rc in (-9, 137), results
+        assert lead_rc == POD_PEER_EXIT, (lead_rc, lead_err[-3000:])
+        partial = _fits_bytes(out)
+        assert len(partial) < self.N_OBS  # it really died mid-run
+
+        # the supervisor's restart: a clean relaunch of the FULL group
+        # resumes the journaled export...
+        results = _spawn_export_group(out, 2, self.N_OBS, self.CHUNK)
+        for rc, _, err in results:
+            assert rc == 0, err[-3000:]
+        # ...to bytes identical to the uninterrupted solo run
+        assert _fits_bytes(out) == want
+
+
+@pytest.mark.faults
+class TestPodFleetGroup:
+    """A fleet replica as a multi-host PROGRAM GROUP
+    (``ReplicaFleet(group_hosts=2)``): one leader process owning the
+    HTTP endpoint + one follower joined to its mesh, supervised as ONE
+    unit — responses byte-identical to a solo single-process replica,
+    and a follower SIGKILL restarts the whole group (leader exits
+    POD_PEER_EXIT through the channel watchdog; the supervisor
+    respawns leader + fresh followers) with service recovering."""
+
+    SPECS = [dict(SERVE_SPEC, seed=700 + i, dm=10.0 + 0.5 * i)
+             for i in range(3)]
+
+    def _drive(self, fleet, specs, deadline_s=180.0):
+        import hashlib
+
+        from psrsigsim_tpu.serve.router import FleetRouter
+
+        router = FleetRouter(fleet)
+        shas = []
+        for spec in specs:
+            status, resp = router.submit(spec, deadline_s=deadline_s,
+                                         wait=True)
+            assert status == 200 and resp.get("status") == "done", (
+                status, resp)
+            shas.append(hashlib.sha256(
+                json.dumps(resp["profile"]).encode()).hexdigest())
+        return shas
+
+    def test_group_serves_identical_and_survives_follower_death(
+            self, tmp_path):
+        import time
+
+        from psrsigsim_tpu.runtime.dist import POD_PEER_EXIT
+        from psrsigsim_tpu.serve.fleet import ReplicaFleet
+
+        solo = ReplicaFleet(1, str(tmp_path / "solo_cache"), widths=(1, 8),
+                            quorum=1)
+        solo.start()
+        try:
+            want = self._drive(solo, self.SPECS)
+        finally:
+            solo.drain()
+
+        fleet = ReplicaFleet(1, str(tmp_path / "pod_cache"), widths=(1, 8),
+                             quorum=1, group_hosts=2,
+                             log_dir=str(tmp_path / "logs"))
+        fleet.start()
+        try:
+            got = self._drive(fleet, self.SPECS)
+            # the pod group's responses are byte-identical to solo
+            assert got == want
+
+            # SIGKILL the follower: the group must restart as one unit
+            leader = fleet._sups[0].proc
+            follower = fleet._group_procs[0][0]
+            os.kill(follower.pid, 9)
+            deadline = time.time() + 120
+            while leader.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            # the leader died LOUDLY through the watchdog, not a hang
+            assert leader.poll() == POD_PEER_EXIT, leader.poll()
+            # ...and the supervisor brings a fresh full group back
+            while time.time() < deadline:
+                if (fleet._sups[0].alive()
+                        and fleet.endpoints()
+                        and fleet._sups[0].proc is not leader):
+                    try:
+                        got2 = self._drive(fleet, self.SPECS[:1])
+                        break
+                    except Exception:
+                        time.sleep(0.5)
+                else:
+                    time.sleep(0.25)
+            else:
+                raise AssertionError("pod group never recovered")
+            assert got2 == want[:1]
+        finally:
+            fleet.drain()
+
+
+@pytest.mark.faults
+class TestPodCluster:
+    """The real multi-process proofs (subprocess local CPU cluster —
+    the fleet_runner pattern).  One combined invocation keeps the
+    tier-1 cost to a single pod sweep."""
+
+    def test_host_count_bit_identity_1_2_4(self):
+        """Ensemble/MC/dataset/serve bytes identical at host counts
+        {1, 2, 4} over a constant 8-device global mesh."""
+        proc = subprocess.run(
+            [sys.executable, POD_RUNNER, "--mode", "identity",
+             "--hosts", "1,2,4", "--families",
+             "ensemble,mc,dataset,serve"],
+            capture_output=True, text=True, timeout=540)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert verdict["ok"], verdict
+        assert verdict["mismatches"] == {}
+        # every family actually contributed a pinned hash
+        for key in ("ensemble_quantized", "ensemble_chunks",
+                    "mc_metrics", "mc_hist", "dataset_records",
+                    "serve_profiles"):
+            assert key in verdict["hashes"], verdict
